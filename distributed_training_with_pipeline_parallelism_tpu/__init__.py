@@ -1,0 +1,23 @@
+"""TPU-native pipeline-parallel training framework.
+
+A brand-new JAX/XLA/pjit framework with the capabilities of
+``aa5490/Distributed-Training-with-Pipeline-Parallelism``: decoder-only
+transformer LM training under GPipe / 1F1B / Interleaved-1F1B pipeline
+schedules, expressed as single-program SPMD over a device mesh with
+``jax.lax.ppermute`` rings instead of torch.distributed P2P over gloo.
+
+Import alias convention: ``import distributed_training_with_pipeline_parallelism_tpu as dtpp``.
+"""
+
+from .utils.config import (MeshConfig, ModelConfig, RunConfig, ScheduleConfig,
+                           virtual_stages_for)
+
+__all__ = [
+    "ModelConfig",
+    "MeshConfig",
+    "ScheduleConfig",
+    "RunConfig",
+    "virtual_stages_for",
+]
+
+__version__ = "0.1.0"

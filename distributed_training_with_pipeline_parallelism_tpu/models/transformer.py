@@ -1,0 +1,166 @@
+"""Decoder-only transformer LMs as pure-JAX parameter pytrees.
+
+Three block families selected by ``ModelConfig.arch``:
+
+- ``ref_decoder`` — reference parity: the reference model
+  (``LLMsDistributedTrainingHelper.py:31-55``) is ``nn.Embedding`` → N ×
+  ``nn.TransformerDecoderLayer(dim, n_heads, batch_first=True)`` → ``LayerNorm``
+  → ``Linear(dim, vocab)``, called as ``layer(h, h)`` — i.e. each decoder layer
+  runs self-attention *and* cross-attention where the memory is the layer's own
+  input hidden state; post-LN; relu FFN of width 2048; **no** causal mask and
+  **no** positional encoding (the reference never passes masks or positions).
+- ``gpt2`` — pre-LN, learned position embeddings, causal self-attn, gelu MLP.
+- ``llama`` — pre-RMSNorm, RoPE, grouped-query causal attention, SwiGLU MLP,
+  no biases.
+
+Parameters are organized for pipeline stage-slicing (SURVEY.md §7: the C3
+``manual_model_split`` equivalent is a pytree partition, not module deletion):
+
+    {"embed": {...}, "layers": <leaves stacked on axis 0 over n_layers>,
+     "head": {"norm": ..., "out": ...}}
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import mha_apply, mha_init, rope_frequencies
+from ..ops.layers import (cross_entropy_loss, embedding_apply, embedding_init,
+                          layer_norm_apply, layer_norm_init, linear_apply,
+                          linear_init, rms_norm_apply, rms_norm_init)
+from ..utils.config import ModelConfig
+
+# ---------------------------------------------------------------------------
+# Per-layer init / apply
+# ---------------------------------------------------------------------------
+
+
+def layer_init(key: jax.Array, cfg: ModelConfig) -> Dict:
+    ks = jax.random.split(key, 8)
+    if cfg.arch == "ref_decoder":
+        return {
+            "self_attn": mha_init(ks[0], cfg.dim, cfg.n_heads),
+            "cross_attn": mha_init(ks[1], cfg.dim, cfg.n_heads),
+            "ln1": layer_norm_init(cfg.dim),
+            "ln2": layer_norm_init(cfg.dim),
+            "ln3": layer_norm_init(cfg.dim),
+            "lin1": linear_init(ks[2], cfg.dim, cfg.ffn_dim),
+            "lin2": linear_init(ks[3], cfg.ffn_dim, cfg.dim),
+        }
+    if cfg.arch == "gpt2":
+        return {
+            "ln1": layer_norm_init(cfg.dim),
+            "attn": mha_init(ks[0], cfg.dim, cfg.n_heads),
+            "ln2": layer_norm_init(cfg.dim),
+            "lin1": linear_init(ks[2], cfg.dim, cfg.ffn_dim),
+            "lin2": linear_init(ks[3], cfg.ffn_dim, cfg.dim),
+        }
+    if cfg.arch == "llama":
+        return {
+            "rms1": rms_norm_init(cfg.dim),
+            "attn": mha_init(ks[0], cfg.dim, cfg.n_heads, cfg.n_kv_heads, bias=False),
+            "rms2": rms_norm_init(cfg.dim),
+            "w1": linear_init(ks[2], cfg.dim, cfg.ffn_dim, bias=False),
+            "w2": linear_init(ks[3], cfg.ffn_dim, cfg.dim, bias=False),
+            "w3": linear_init(ks[4], cfg.dim, cfg.ffn_dim, bias=False),
+        }
+    raise ValueError(f"unknown arch {cfg.arch!r}")
+
+
+def layer_apply(cfg: ModelConfig, params: Dict, h: jax.Array,
+                rope_angles: Optional[jax.Array] = None) -> jax.Array:
+    if cfg.arch == "ref_decoder":
+        mem = h  # the reference calls layer(h, h): memory is the layer's input
+        x = layer_norm_apply(params["ln1"], h + mha_apply(params["self_attn"], h, h, cfg.n_heads))
+        x = layer_norm_apply(params["ln2"], x + mha_apply(params["cross_attn"], x, mem, cfg.n_heads))
+        ff = linear_apply(params["lin2"], jax.nn.relu(linear_apply(params["lin1"], x)))
+        return layer_norm_apply(params["ln3"], x + ff)
+    if cfg.arch == "gpt2":
+        a = layer_norm_apply(params["ln1"], h)
+        h = h + mha_apply(params["attn"], a, a, cfg.n_heads, causal=cfg.causal)
+        m = layer_norm_apply(params["ln2"], h)
+        return h + linear_apply(params["lin2"], jax.nn.gelu(linear_apply(params["lin1"], m)))
+    if cfg.arch == "llama":
+        a = rms_norm_apply(params["rms1"], h, cfg.rms_eps)
+        h = h + mha_apply(params["attn"], a, a, cfg.n_heads, causal=cfg.causal,
+                          rope_angles=rope_angles)
+        m = rms_norm_apply(params["rms2"], h, cfg.rms_eps)
+        ff = linear_apply(params["w2"],
+                          jax.nn.silu(linear_apply(params["w1"], m)) * linear_apply(params["w3"], m))
+        return h + ff
+    raise ValueError(f"unknown arch {cfg.arch!r}")
+
+
+# ---------------------------------------------------------------------------
+# Whole-model init / apply
+# ---------------------------------------------------------------------------
+
+
+def transformer_init(key: jax.Array, cfg: ModelConfig) -> Dict:
+    ke, kp, kl, kn, ko = jax.random.split(key, 5)
+    embed: Dict = {"tok": embedding_init(ke, cfg.vocab_size, cfg.dim)}
+    if cfg.arch == "gpt2":
+        embed["pos"] = 0.02 * jax.random.normal(kp, (cfg.max_seq_len, cfg.dim))
+    layer_keys = jax.random.split(kl, cfg.n_layers)
+    layers = jax.vmap(lambda k: layer_init(k, cfg))(layer_keys)
+    if cfg.arch == "llama":
+        head = {"norm": rms_norm_init(cfg.dim),
+                "out": linear_init(ko, cfg.dim, cfg.vocab_size, bias=False)}
+    else:
+        head = {"norm": layer_norm_init(cfg.dim),
+                "out": linear_init(ko, cfg.dim, cfg.vocab_size, bias=cfg.arch == "ref_decoder")}
+    params = {"embed": embed, "layers": layers, "head": head}
+    dtype = jnp.dtype(cfg.dtype)
+    if dtype != jnp.float32:
+        params = jax.tree.map(lambda x: x.astype(dtype), params)
+    return params
+
+
+def embed_apply(cfg: ModelConfig, embed: Dict, tokens: jax.Array) -> jax.Array:
+    h = embedding_apply(embed["tok"], tokens)
+    if cfg.arch == "gpt2":
+        h = h + embed["pos"][: tokens.shape[1]]
+    return h
+
+
+def _rope(cfg: ModelConfig, seq_len: int) -> Optional[jax.Array]:
+    if cfg.arch != "llama":
+        return None
+    return rope_frequencies(cfg.head_dim, seq_len, cfg.rope_theta)
+
+
+def body_apply(cfg: ModelConfig, layers: Dict, h: jax.Array) -> jax.Array:
+    """Run a stack of layers whose leaves are stacked on axis 0 (any count)."""
+    rope = _rope(cfg, h.shape[1])
+
+    def step(carry, layer_params):
+        return layer_apply(cfg, layer_params, carry, rope), None
+
+    out, _ = jax.lax.scan(step, h, layers)
+    return out
+
+
+def head_apply(cfg: ModelConfig, head: Dict, h: jax.Array) -> jax.Array:
+    if cfg.arch == "llama":
+        h = rms_norm_apply(head["norm"], h, cfg.rms_eps)
+    else:
+        h = layer_norm_apply(head["norm"], h)
+    return linear_apply(head["out"], h)
+
+
+def transformer_apply(cfg: ModelConfig, params: Dict, tokens: jax.Array) -> jax.Array:
+    """Full-model forward: tokens [B, S] -> logits [B, S, V]."""
+    h = embed_apply(cfg, params["embed"], tokens)
+    h = body_apply(cfg, params["layers"], h)
+    return head_apply(cfg, params["head"], h)
+
+
+def transformer_loss(cfg: ModelConfig, params: Dict, tokens: jax.Array,
+                     targets: jax.Array) -> jax.Array:
+    """Single-device reference loss — the ground truth the pipeline executors
+    are verified against (a check the reference itself never performs,
+    SURVEY.md §4)."""
+    return cross_entropy_loss(transformer_apply(cfg, params, tokens), targets)

@@ -1,0 +1,81 @@
+"""Multi-head attention as a pure function.
+
+Semantics match ``torch.nn.MultiheadAttention`` (batch_first): packed Q/K/V
+projections, scaled dot-product over heads, output projection. Exposed as
+separate q/k/v weight leaves so stage-stacking and tensor-parallel sharding
+stay natural; the torch-parity test splits torch's packed ``in_proj_weight``
+into these leaves.
+
+Supports grouped-query attention (n_kv_heads < n_heads) and an optional RoPE
+rotation for the Llama family.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .layers import linear_init, linear_apply
+
+
+def mha_init(key: jax.Array, dim: int, n_heads: int, n_kv_heads: Optional[int] = None,
+             bias: bool = True) -> Dict:
+    n_kv_heads = n_kv_heads or n_heads
+    head_dim = dim // n_heads
+    kq, kk, kv, ko = jax.random.split(key, 4)
+    return {
+        "q": linear_init(kq, dim, n_heads * head_dim, bias=bias),
+        "k": linear_init(kk, dim, n_kv_heads * head_dim, bias=bias),
+        "v": linear_init(kv, dim, n_kv_heads * head_dim, bias=bias),
+        "o": linear_init(ko, n_heads * head_dim, dim, bias=bias),
+    }
+
+
+def _split_heads(x: jax.Array, n_heads: int) -> jax.Array:
+    b, s, _ = x.shape
+    return x.reshape(b, s, n_heads, -1)
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 10000.0) -> jax.Array:
+    """Precompute RoPE angles [max_seq_len, head_dim//2]."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    return jnp.outer(t, inv)
+
+
+def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
+    """Rotate [b, s, h, d] query/key tensors by per-position angles [s, d//2]."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)  # rotation runs in f32; don't promote bf16 activations
+
+
+def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
+              causal: bool = False, rope_angles: Optional[jax.Array] = None) -> jax.Array:
+    """Attention: queries from ``q_in``, keys/values from ``kv_in`` (both [b, s, d])."""
+    head_dim = params["q"]["w"].shape[1] // n_heads
+    n_kv = params["k"]["w"].shape[1] // head_dim
+    q = _split_heads(linear_apply(params["q"], q_in), n_heads)
+    k = _split_heads(linear_apply(params["k"], kv_in), n_kv)
+    v = _split_heads(linear_apply(params["v"], kv_in), n_kv)
+    if rope_angles is not None:
+        q = apply_rope(q, rope_angles)
+        k = apply_rope(k, rope_angles)
+    if n_kv != n_heads:  # grouped-query: repeat kv heads
+        rep = n_heads // n_kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=q.dtype))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        s = q_in.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = out.reshape(q_in.shape[0], q_in.shape[1], -1)
+    return linear_apply(params["o"], out)

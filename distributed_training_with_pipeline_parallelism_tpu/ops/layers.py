@@ -1,0 +1,72 @@
+"""Elementary neural-net ops as pure functions over parameter pytrees.
+
+All ops take a params dict and return arrays; initializers mirror torch's
+defaults closely enough for healthy training (the reference never asserts loss
+values — SURVEY.md §0 — so distributional parity, not bit parity, is the bar;
+bit-level parity against torch is established in tests by copying weights).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def linear_init(key: jax.Array, in_dim: int, out_dim: int, bias: bool = True) -> Dict:
+    """Kaiming-uniform weight + uniform bias, matching ``torch.nn.Linear.reset_parameters``."""
+    wkey, bkey = jax.random.split(key)
+    bound = 1.0 / math.sqrt(in_dim)
+    params = {"w": jax.random.uniform(wkey, (in_dim, out_dim), minval=-bound, maxval=bound)}
+    if bias:
+        params["b"] = jax.random.uniform(bkey, (out_dim,), minval=-bound, maxval=bound)
+    return params
+
+
+def linear_apply(params: Dict, x: jax.Array) -> jax.Array:
+    y = x @ params["w"]
+    if "b" in params:
+        y = y + params["b"]
+    return y
+
+
+def layer_norm_init(dim: int) -> Dict:
+    return {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}
+
+
+def layer_norm_apply(params: Dict, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    xn = (x - mean) * jax.lax.rsqrt(var + eps)
+    return xn * params["scale"] + params["bias"]
+
+
+def rms_norm_init(dim: int) -> Dict:
+    return {"scale": jnp.ones((dim,))}
+
+
+def rms_norm_apply(params: Dict, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(ms + eps) * params["scale"]
+
+
+def embedding_init(key: jax.Array, vocab: int, dim: int) -> jax.Array:
+    """N(0, 1) like ``torch.nn.Embedding``."""
+    return jax.random.normal(key, (vocab, dim))
+
+
+def embedding_apply(table: jax.Array, tokens: jax.Array) -> jax.Array:
+    return jnp.take(table, tokens, axis=0)
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean token-wise cross entropy over all positions.
+
+    Matches the reference's ``tokenwise_loss_fn`` — ``nn.CrossEntropyLoss`` over
+    flattened ``(B*S, V)`` logits (``LLMsDistributedTrainingHelper.py:197-201``).
+    """
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logz, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

@@ -1,0 +1,126 @@
+"""Configuration dataclasses for models, meshes, schedules, and runs.
+
+Reference parity: the reference keeps hyperparameters in a tiny ``ModelArgs``
+dataclass (``LLMsDistributedTrainingHelper.py:23-28``: dim=768, n_layers=8,
+n_heads=8, vocab_size=10000) and hard-codes run constants (batch 32, seq 128,
+4 microbatches) inline. Here every knob is an explicit dataclass so the sweep
+driver stays declarative.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Decoder-only transformer LM hyperparameters.
+
+    Defaults mirror the reference's ``ModelArgs`` plus the implicit defaults it
+    inherits from ``nn.TransformerDecoderLayer`` (ffn_dim=2048, post-LN,
+    relu activation, no causal mask, no positional encoding —
+    ``LLMsDistributedTrainingHelper.py:31-55`` never passes masks and never adds
+    position embeddings).
+
+    ``arch`` selects the block family:
+      - "ref_decoder": reference-parity block — post-LN, self-attn + cross-attn
+        where memory == the block's own input (``layer(h, h)``), relu MLP.
+      - "gpt2": pre-LN, causal self-attn, gelu MLP, learned position embeddings.
+      - "llama": pre-RMSNorm, causal self-attn with RoPE, SwiGLU MLP, no biases,
+        tied-free output head.
+    """
+
+    dim: int = 768
+    n_layers: int = 8
+    n_heads: int = 8
+    vocab_size: int = 10000
+    ffn_dim: int = 2048
+    max_seq_len: int = 2048
+    arch: str = "ref_decoder"
+    dropout: float = 0.0  # reference implicitly trains with torch's default 0.1;
+    # we default to 0.0 for determinism (loss values are never asserted by the
+    # reference — only throughput — so this does not affect parity).
+    dtype: str = "float32"
+    # Llama-only knobs.
+    n_kv_heads: Optional[int] = None
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+
+    def __post_init__(self):
+        if self.dim % self.n_heads != 0:
+            raise ValueError(f"dim={self.dim} must be divisible by n_heads={self.n_heads}")
+        if self.n_kv_heads is not None and self.n_heads % self.n_kv_heads != 0:
+            raise ValueError(f"n_heads={self.n_heads} must be divisible by n_kv_heads={self.n_kv_heads}")
+        if self.arch not in ("ref_decoder", "gpt2", "llama"):
+            raise ValueError(f"unknown arch {self.arch!r}")
+        if self.dropout != 0.0:
+            raise ValueError("dropout is not implemented yet; the reference implicitly "
+                             "trains with torch's default 0.1 but never asserts loss "
+                             "values, so 0.0 preserves behavioral parity")
+
+    @property
+    def causal(self) -> bool:
+        return self.arch != "ref_decoder"
+
+    @property
+    def head_dim(self) -> int:
+        assert self.dim % self.n_heads == 0
+        return self.dim // self.n_heads
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh shape. axis order is ('data', 'pipe')."""
+
+    n_pipe: int = 2
+    n_data: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.n_pipe * self.n_data
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleConfig:
+    """Pipeline schedule selection.
+
+    ``name`` in {"GPipe", "1F1B", "Interleaved1F1B"} — the same strings the
+    reference dispatches on (``LLMsDistributedTrainingHelper.py:215-220``).
+    ``n_microbatches`` defaults to the reference's fixed 4 (``:214``).
+    ``n_virtual`` is the number of virtual stages per device; the reference picks
+    2 iff ``schedule=='Interleaved1F1B' and n_layers % (world_size*2)==0`` else
+    1 (``:181-185``) — use :func:`virtual_stages_for` to reproduce that rule.
+    """
+
+    name: str = "GPipe"
+    n_microbatches: int = 4
+    n_virtual: int = 1
+
+    def __post_init__(self):
+        if self.name not in SCHEDULE_NAMES:
+            raise ValueError(f"unknown schedule {self.name!r}; expected one of {SCHEDULE_NAMES}")
+
+
+SCHEDULE_NAMES = ("GPipe", "1F1B", "Interleaved1F1B")
+
+
+def virtual_stages_for(schedule_name: str, n_layers: int, n_pipe: int) -> int:
+    """Reference rule for stages-per-worker (``LLMsDistributedTrainingHelper.py:181-185``)."""
+    if schedule_name not in SCHEDULE_NAMES:
+        raise ValueError(f"unknown schedule {schedule_name!r}; expected one of {SCHEDULE_NAMES}")
+    if schedule_name == "Interleaved1F1B" and n_layers % (n_pipe * 2) == 0:
+        return 2
+    return 1
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """One experiment's run parameters (reference: ``run_one_experiment`` kwargs,
+    notebook cell 19)."""
+
+    batch_size: int = 32
+    seq_length: int = 128
+    num_iterations: int = 5
+    warmup_iterations: int = 2
+    seed: int = 0

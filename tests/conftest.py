@@ -1,0 +1,20 @@
+"""Test configuration: simulate an 8-device TPU-like mesh on CPU.
+
+This is the JAX analog of the reference's multi-node-without-a-cluster trick
+(gloo over localhost TCP, SURVEY.md §4): ``xla_force_host_platform_device_count``
+gives N fake devices so pipeline schedules run real collectives in CI with no
+pod. Must run before the first backend initialization; the surrounding
+environment force-selects the axon TPU plugin via JAX_PLATFORMS, so we also
+override through jax.config (env alone is not enough here).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", "")
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
