@@ -1,0 +1,288 @@
+"""SPMD pipeline-parallel executor: tick tables -> one jitted program.
+
+TPU-native replacement for the reference's entire L2+L1 stack (SURVEY.md §1):
+where torch builds per-process ``PipelineStage`` objects exchanging
+activations via batched gloo P2P (``stage.py:463-603``) under a Python
+schedule loop (``schedules.py:740``), here the *whole pipeline* — all stages,
+all microbatches, forward and backward — is a single ``shard_map``-ped
+program over a ``Mesh(('data', 'pipe'))``:
+
+- **Stage placement**: layer parameters are stacked ``[D, V, layers/stage, ...]``
+  and sharded over the 'pipe' axis — the pytree-partition equivalent of the
+  reference's ``manual_model_split`` module deletion
+  (``LLMsDistributedTrainingHelper.py:60-94``), including the interleaved wrap
+  placement ``stage = rank + world_size * v`` (``:208``).
+- **Transport**: every tick ends with two ``jax.lax.ppermute`` ring shifts
+  (+1 for activations, -1 for gradients) — the ICI-native replacement for
+  ``dist.batch_isend_irecv`` over gloo/TCP (SURVEY.md U6). Shapes are static
+  under jit, so the reference's runtime shape-metadata exchange
+  (``stage.py:1720-1744``) has no equivalent here at all.
+- **Schedule execution**: a ``lax.scan`` over the compiled tick table
+  (:mod:`.schedules`). Each tick conditionally runs one forward or backward
+  unit; devices idle in the bubble run the (cheap) false branches. This is
+  the SPMD analog of upstream's lowered action-IR interpreter
+  (``_PipelineScheduleRuntime._step_microbatches``, ``schedules.py:2407``).
+- **Backward**: rematerializing — the forward unit saves only the stage
+  *input* per in-flight microbatch in a slot-addressed rotating buffer sized
+  from the schedule's actual activation lifetimes (so 1F1B keeps its
+  O(in-flight) ~ O(D) activation-memory advantage over GPipe's O(M));
+  the backward unit recomputes the stage forward under ``jax.value_and_grad``
+  — one extra stage forward per backward, the standard TPU trade of MXU FLOPs
+  for HBM (SURVEY.md §7 hard-part (b)).
+- **Loss / grad semantics**: token-mean CE per microbatch on the last stage,
+  accumulated across microbatches and scaled by 1/M — reproducing upstream's
+  ``scale_grads`` behavior (``schedules.py:692-694``) and the reference's
+  ``tokenwise_loss_fn`` (``LLMsDistributedTrainingHelper.py:197-201``), so a
+  pipeline step's (loss, grads) match a single-device full-batch step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.transformer import (body_apply, embed_apply, head_apply)
+from ..ops.layers import cross_entropy_loss
+from ..utils.config import ModelConfig, ScheduleConfig
+from .mesh import DATA_AXIS, PIPE_AXIS
+from .schedules import (COL_BWD_ASLOT, COL_BWD_GSLOT, COL_BWD_M, COL_BWD_V,
+                        COL_FWD_M, COL_FWD_SLOT, COL_FWD_V, COL_STORE_B_SLOT,
+                        COL_STORE_F_SLOT, CompiledSchedule, compile_schedule)
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:  # jax >= 0.6 exposes shard_map at top level (check_vma kwarg)
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except AttributeError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map as esm
+        return esm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+Pytree = Any
+
+
+# ---------------------------------------------------------------------------
+# Stage slicing: full-model pytree <-> stacked per-device layout
+# ---------------------------------------------------------------------------
+
+
+def stack_stage_layers(layers: Pytree, n_devices: int, n_virtual: int) -> Pytree:
+    """[L, ...] leaves -> [D, V, L/S, ...]: device d, virtual v holds global
+    stage v*D + d (the reference's wrap placement)."""
+
+    def reshape(x):
+        L = x.shape[0]
+        S = n_devices * n_virtual
+        if L % S != 0:
+            raise ValueError(f"n_layers={L} must divide evenly into {S} stages")
+        lps = L // S
+        return (x.reshape(n_virtual, n_devices, lps, *x.shape[1:])
+                .swapaxes(0, 1))
+
+    return jax.tree.map(reshape, layers)
+
+
+def unstack_stage_layers(stacked: Pytree) -> Pytree:
+    """Inverse of :func:`stack_stage_layers`: [D, V, lps, ...] -> [L, ...]."""
+
+    def reshape(x):
+        D, V, lps = x.shape[:3]
+        return x.swapaxes(0, 1).reshape(V * D * lps, *x.shape[3:])
+
+    return jax.tree.map(reshape, stacked)
+
+
+# ---------------------------------------------------------------------------
+# The executor
+# ---------------------------------------------------------------------------
+
+
+def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
+                       ) -> Callable[[Pytree, jax.Array, jax.Array],
+                                     Tuple[jax.Array, Pytree]]:
+    """Build a jitted training step ``(params, tokens, targets) -> (loss, grads)``.
+
+    ``params`` is the full-model pytree from ``transformer_init``; ``grads``
+    comes back in the same layout. ``tokens``/``targets`` are ``[B, S]`` with
+    ``B`` divisible by (n_data * n_microbatches); the batch is split over the
+    'data' mesh axis, then into microbatches along dim 0 (upstream
+    ``DEFAULT_CHUNK_DIM=0``, ``microbatch.py:57``).
+
+    Matching the reference's measurement semantics (SURVEY.md §3.3 note): the
+    step computes loss and gradients only — no optimizer update — so it can be
+    timed exactly like ``schedule.step``. Compose with optax externally.
+    """
+    D = mesh.shape[PIPE_AXIS]
+    n_data = mesh.shape.get(DATA_AXIS, 1)
+    V = sched.n_virtual
+    M = sched.n_microbatches
+    cs: CompiledSchedule = compile_schedule(sched.name, D, V, M)
+    table = jnp.asarray(cs.table)  # [T, D, 8]
+    dtype = jnp.dtype(cfg.dtype)
+    fwd_perm = [(i, (i + 1) % D) for i in range(D)]
+    bwd_perm = [(i, (i - 1) % D) for i in range(D)]
+
+    def spmd_fn(layers_stacked, embed, head, tokens, targets):
+        # Shapes inside shard_map: layers_stacked leaves [1, V, lps, ...];
+        # embed/head replicated; tokens/targets [B_local, S].
+        d = jax.lax.axis_index(PIPE_AXIS)
+        layers_local = jax.tree.map(lambda x: x[0], layers_stacked)
+        is_first_dev = d == 0
+        is_last_dev = d == D - 1
+
+        b_local, seq = tokens.shape
+        assert b_local % M == 0, (
+            f"local batch {b_local} not divisible by n_microbatches={M}")
+        mb = b_local // M
+        tokens_mb = tokens.reshape(M, mb, seq)
+        targets_mb = targets.reshape(M, mb, seq)
+        mb_shape = (mb, seq, cfg.dim)
+
+        def stage_body(layer_p, x):
+            return body_apply(cfg, layer_p, x)
+
+        def select_v(tree, v):
+            return jax.tree.map(
+                lambda x: jax.lax.dynamic_index_in_dim(x, v, 0, keepdims=False),
+                tree)
+
+        def masked_store(buf, reg, slot):
+            active = slot >= 0
+            ss = jnp.maximum(slot, 0)
+            new = jnp.where(active, reg, buf[ss])
+            return buf.at[ss].set(new)
+
+        def tick(carry, row_all):
+            (act_buf, grad_buf, fwd_recv, bwd_recv,
+             g_layers, g_embed, g_head, loss_acc) = carry
+            row = row_all[d]
+
+            # 1. bank arrivals from last tick's ppermute
+            act_buf = masked_store(act_buf, fwd_recv, row[COL_STORE_F_SLOT])
+            grad_buf = masked_store(grad_buf, bwd_recv, row[COL_STORE_B_SLOT])
+
+            # 2. forward unit
+            fv, fm, fslot = row[COL_FWD_V], row[COL_FWD_M], row[COL_FWD_SLOT]
+
+            def fwd_unit(act_buf):
+                vv, mm = jnp.maximum(fv, 0), jnp.maximum(fm, 0)
+                ss = jnp.maximum(fslot, 0)
+                first_stage = is_first_dev & (vv == 0)
+                x_emb = embed_apply(cfg, embed, tokens_mb[mm]).astype(dtype)
+                x = jnp.where(first_stage, x_emb, act_buf[ss])
+                act_buf = act_buf.at[ss].set(x)  # saved for remat backward
+                y = stage_body(select_v(layers_local, vv), x)
+                return act_buf, y
+
+            def fwd_noop(act_buf):
+                return act_buf, jnp.zeros(mb_shape, dtype)
+
+            act_buf, fwd_send = jax.lax.cond(fm >= 0, fwd_unit, fwd_noop, act_buf)
+
+            # 3. backward unit (rematerializing)
+            bv, bm = row[COL_BWD_V], row[COL_BWD_M]
+
+            def bwd_unit(operand):
+                g_layers, g_embed, g_head, loss_acc = operand
+                vv, mm = jnp.maximum(bv, 0), jnp.maximum(bm, 0)
+                last_stage = is_last_dev & (vv == V - 1)
+                first_stage = is_first_dev & (vv == 0)
+                x = act_buf[jnp.maximum(row[COL_BWD_ASLOT], 0)]
+                g_in = grad_buf[jnp.maximum(row[COL_BWD_GSLOT], 0)]
+                params_v = select_v(layers_local, vv)
+
+                def objective(p_v, head_p, x_in):
+                    y = stage_body(p_v, x_in)
+                    # Last stage: real loss through the head. Other stages:
+                    # contract with the incoming cotangent, whose gradient
+                    # w.r.t. (p_v, x_in) is exactly the VJP.
+                    return jax.lax.cond(
+                        last_stage,
+                        lambda: cross_entropy_loss(
+                            head_apply(cfg, head_p, y), targets_mb[mm]),
+                        lambda: jnp.sum(y.astype(jnp.float32)
+                                        * g_in.astype(jnp.float32)))
+
+                loss_val, (gp, gh, gx) = jax.value_and_grad(
+                    objective, argnums=(0, 1, 2))(params_v, head, x)
+
+                g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
+                                        g_layers, gp)
+                g_head = jax.tree.map(jnp.add, g_head, gh)
+                g_embed = jax.lax.cond(
+                    first_stage,
+                    lambda: jax.tree.map(
+                        jnp.add, g_embed,
+                        jax.grad(lambda e: jnp.vdot(
+                            embed_apply(cfg, e, tokens_mb[mm]).astype(jnp.float32),
+                            gx.astype(jnp.float32)))(embed)),
+                    lambda: g_embed)
+                loss_acc = loss_acc + jnp.where(last_stage, loss_val, 0.0)
+                return (g_layers, g_embed, g_head, loss_acc), gx
+
+            def bwd_noop(operand):
+                return operand, jnp.zeros(mb_shape, dtype)
+
+            (g_layers, g_embed, g_head, loss_acc), bwd_send = jax.lax.cond(
+                bm >= 0, bwd_unit, bwd_noop,
+                (g_layers, g_embed, g_head, loss_acc))
+
+            # 4. ring transfer: activations +1, gradients -1 (ICI hops)
+            fwd_recv = jax.lax.ppermute(fwd_send, PIPE_AXIS, fwd_perm)
+            bwd_recv = jax.lax.ppermute(bwd_send, PIPE_AXIS, bwd_perm)
+
+            return (act_buf, grad_buf, fwd_recv, bwd_recv,
+                    g_layers, g_embed, g_head, loss_acc), None
+
+        carry0 = (
+            jnp.zeros((cs.n_act_slots,) + mb_shape, dtype),
+            jnp.zeros((cs.n_grad_slots,) + mb_shape, dtype),
+            jnp.zeros(mb_shape, dtype),
+            jnp.zeros(mb_shape, dtype),
+            jax.tree.map(jnp.zeros_like, layers_local),
+            jax.tree.map(jnp.zeros_like, embed),
+            jax.tree.map(jnp.zeros_like, head),
+            jnp.zeros((), jnp.float32),
+        )
+        carry, _ = jax.lax.scan(tick, carry0, table)
+        (_, _, _, _, g_layers, g_embed, g_head, loss_acc) = carry
+
+        # Reductions: loss lives on the last stage only; embed/head grads on
+        # one device each — psum replicates them across 'pipe'. Scale by 1/M
+        # (upstream scale_grads semantics) and mean over data replicas.
+        inv = 1.0 / M
+        loss = jax.lax.psum(loss_acc, PIPE_AXIS) * inv
+        g_layers = jax.tree.map(lambda x: x[None] * inv, g_layers)
+        g_embed = jax.tree.map(lambda x: jax.lax.psum(x * inv, PIPE_AXIS), g_embed)
+        g_head = jax.tree.map(lambda x: jax.lax.psum(x * inv, PIPE_AXIS), g_head)
+        if n_data > 1:
+            nd = 1.0 / n_data
+            loss = jax.lax.psum(loss * nd, DATA_AXIS)
+            g_layers, g_embed, g_head = jax.tree.map(
+                lambda x: jax.lax.psum(x * nd, DATA_AXIS),
+                (g_layers, g_embed, g_head))
+        return loss, g_layers, g_embed, g_head
+
+    sharded = _shard_map(
+        spmd_fn, mesh,
+        in_specs=(P(PIPE_AXIS), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(PIPE_AXIS), P(), P()),
+    )
+
+    @jax.jit
+    def step(params, tokens, targets):
+        stacked = stack_stage_layers(params["layers"], D, V)
+        loss, g_layers, g_embed, g_head = sharded(
+            stacked, params["embed"], params["head"], tokens, targets)
+        grads = {
+            "embed": g_embed,
+            "layers": unstack_stage_layers(g_layers),
+            "head": g_head,
+        }
+        return loss, grads
+
+    return step

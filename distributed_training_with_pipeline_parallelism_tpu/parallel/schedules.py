@@ -1,0 +1,504 @@
+"""Pipeline-schedule IR: per-device action lists, tick scheduling, validation.
+
+The reference delegates scheduling to ``torch.distributed.pipelining``
+(SURVEY.md U2-U4): ``ScheduleGPipe`` (fill-drain, ``schedules.py:872``),
+``Schedule1F1B`` (warmup/steady/cooldown, ``schedules.py:995``), and
+``ScheduleInterleaved1F1B`` (explicit per-rank action-list IR over virtual
+stages, ``schedules.py:2891``, after Megatron-LM arXiv:2104.04473).
+
+This module re-expresses all three as a host-side IR compiled for a
+single-program SPMD executor:
+
+1. **Action lists** — for each device, an ordered list of
+   ``Action(stage, op, microbatch)`` (``op`` in {F, B}; ``stage`` is the
+   *global* stage index; device(stage) = stage % n_devices, virtual index
+   v = stage // n_devices — the reference's wrap placement
+   ``stage_idx = rank + world_size * i``, ``LLMsDistributedTrainingHelper.py:208``).
+2. **Tick scheduling** — an ASAP list scheduler assigns each action to a
+   discrete tick: one compute action per device per tick, actions execute
+   in list order per device, and a cross-device data dependency costs one
+   tick of transfer latency (the ``ppermute`` hop).
+3. **Tick tables** — dense int32 arrays the SPMD executor scans over; every
+   entry is static, so the whole schedule compiles into one XLA program with
+   no data-dependent control flow.
+
+Under jit the ticks become real lockstep super-steps separated by
+``ppermute`` collectives, so the tick abstraction here *is* the runtime
+model, not just an analysis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+F = "F"
+B = "B"
+
+
+@dataclasses.dataclass(frozen=True)
+class Action:
+    stage: int  # global stage index in [0, n_stages)
+    op: str  # F or B
+    microbatch: int
+
+
+class ScheduleError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Per-device action-order generators
+# ---------------------------------------------------------------------------
+
+
+def gpipe_order(n_devices: int, n_microbatches: int) -> List[List[Action]]:
+    """Fill-drain: all forwards in microbatch order, then all backwards.
+
+    Mirrors upstream ScheduleGPipe semantics (SURVEY.md U2): per stage, M
+    forwards then M backwards, both in increasing microbatch order.
+    """
+    orders = []
+    for d in range(n_devices):
+        acts = [Action(d, F, m) for m in range(n_microbatches)]
+        acts += [Action(d, B, m) for m in range(n_microbatches)]
+        orders.append(acts)
+    return orders
+
+
+def one_f_one_b_order(n_devices: int, n_microbatches: int) -> List[List[Action]]:
+    """1F1B: per-device warmup of (D-1-d) forwards, steady-state alternating
+    F/B, cooldown backwards (SURVEY.md U3; upstream requires M >= D,
+    ``schedules.py:1020-1024`` — enforced here too)."""
+    D, M = n_devices, n_microbatches
+    if M < D:
+        raise ScheduleError(f"1F1B requires n_microbatches >= n_devices ({M} < {D})")
+    orders = []
+    for d in range(D):
+        warmup = min(M, D - 1 - d)
+        acts = [Action(d, F, m) for m in range(warmup)]
+        nf, nb = warmup, 0
+        while nf < M:  # steady state: one forward, one backward
+            acts.append(Action(d, F, nf))
+            nf += 1
+            acts.append(Action(d, B, nb))
+            nb += 1
+        acts += [Action(d, B, m) for m in range(nb, M)]
+        orders.append(acts)
+    return orders
+
+
+def interleaved_order(n_devices: int, n_virtual: int,
+                      n_microbatches: int) -> List[List[Action]]:
+    """Interleaved 1F1B over V virtual stages per device (Megatron-LM style,
+    upstream ``ScheduleInterleaved1F1B``, SURVEY.md U4).
+
+    Global stage v * D + d lives on device d (wrap placement). Forwards are
+    issued in rounds of ``mb_per_round`` microbatches per virtual stage;
+    warmup depth is ``(V-1) * mb_per_round + 2 * (D-1-d)``; steady state is
+    one-forward-one-backward; backward virtual-stage order is reversed.
+    Upstream requires ``n_mb % num_rounds == 0`` with
+    ``num_rounds = max(1, n_mb // D)`` (``schedules.py:2935-2942``).
+
+    With V == 1 this degenerates to the plain 1F1B layout — matching the
+    reference's fallback when ``n_layers % (world_size*2) != 0``
+    (``LLMsDistributedTrainingHelper.py:181-185``).
+    """
+    D, V, M = n_devices, n_virtual, n_microbatches
+    if V == 1:
+        return one_f_one_b_order(D, M)
+    num_rounds = max(1, M // D)
+    if M % num_rounds != 0:
+        raise ScheduleError(
+            f"Interleaved1F1B requires n_microbatches % num_rounds == 0 "
+            f"(M={M}, num_rounds={num_rounds})")
+    mbpr = M // num_rounds  # microbatches per round
+
+    def fwd_vm(i: int) -> Tuple[int, int]:
+        v = (i // mbpr) % V
+        m = (i // (mbpr * V)) * mbpr + (i % mbpr)
+        return v, m
+
+    def bwd_vm(j: int) -> Tuple[int, int]:
+        v = V - 1 - ((j // mbpr) % V)
+        m = (j // (mbpr * V)) * mbpr + (j % mbpr)
+        return v, m
+
+    total = M * V
+    orders = []
+    for d in range(D):
+        warmup = min(total, (V - 1) * mbpr + 2 * (D - 1 - d))
+        acts = []
+        nf = nb = 0
+        for _ in range(warmup):
+            v, m = fwd_vm(nf)
+            acts.append(Action(v * D + d, F, m))
+            nf += 1
+        while nf < total:  # steady state
+            v, m = fwd_vm(nf)
+            acts.append(Action(v * D + d, F, m))
+            nf += 1
+            v, m = bwd_vm(nb)
+            acts.append(Action(v * D + d, B, m))
+            nb += 1
+        while nb < total:  # cooldown
+            v, m = bwd_vm(nb)
+            acts.append(Action(v * D + d, B, m))
+            nb += 1
+        orders.append(acts)
+    return orders
+
+
+def build_order(name: str, n_devices: int, n_virtual: int,
+                n_microbatches: int) -> List[List[Action]]:
+    if name == "GPipe":
+        if n_virtual != 1:
+            raise ScheduleError("GPipe supports a single stage per device")
+        return gpipe_order(n_devices, n_microbatches)
+    if name == "1F1B":
+        if n_virtual != 1:
+            raise ScheduleError("1F1B supports a single stage per device")
+        return one_f_one_b_order(n_devices, n_microbatches)
+    if name == "Interleaved1F1B":
+        return interleaved_order(n_devices, n_virtual, n_microbatches)
+    raise ScheduleError(f"unknown schedule {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# Tick scheduling (ASAP list scheduler)
+# ---------------------------------------------------------------------------
+
+
+def schedule_ticks(orders: List[List[Action]], n_devices: int, n_virtual: int,
+                   ) -> Tuple[Dict[Action, int], int]:
+    """Assign each action a tick. Returns (action -> tick, makespan).
+
+    Rules: one action per device per tick; per-device actions run in list
+    order; F(s, m) needs F(s-1, m) completed >= 1 tick earlier when the stages
+    live on different devices (ppermute latency), B(s, m) needs F(s, m) (same
+    device, activations saved locally) and B(s+1, m) >= 1 tick earlier.
+
+    This is the deadlock-freedom analog of upstream's ``_validate_schedule``
+    (``schedules.py:1619``) plus gloo's peer-sorted P2P batching
+    (SURVEY.md §5 race-detection row): here deadlocks surface as a scheduling
+    error at compile time rather than a hang at run time.
+    """
+    D = n_devices
+    S = D * n_virtual
+    n_actions = sum(len(o) for o in orders)
+    done: Dict[Action, int] = {}
+    ptr = [0] * D
+    t = 0
+    limit = 4 * n_actions + 4 * S + 16
+
+    def device_of(stage: int) -> int:
+        return stage % D
+
+    def ready(a: Action, now: int) -> bool:
+        if a.op == F:
+            if a.stage == 0:
+                return True
+            dep = Action(a.stage - 1, F, a.microbatch)
+            # one tick of ppermute latency (for D == 1 the +1 is subsumed by
+            # one-action-per-tick, so the same rule applies)
+            return dep in done and done[dep] + 1 <= now
+        # backward
+        if Action(a.stage, F, a.microbatch) not in done:
+            return False
+        if a.stage == S - 1:
+            return True
+        dep = Action(a.stage + 1, B, a.microbatch)
+        return dep in done and done[dep] + 1 <= now
+
+    while any(ptr[d] < len(orders[d]) for d in range(D)):
+        if t > limit:
+            raise ScheduleError("schedule deadlocked: no progress within tick limit")
+        for d in range(D):
+            if ptr[d] >= len(orders[d]):
+                continue
+            a = orders[d][ptr[d]]
+            if device_of(a.stage) != d:
+                raise ScheduleError(f"action {a} listed on device {d}")
+            if ready(a, t):
+                done[a] = t
+                ptr[d] += 1
+        t += 1
+    return done, t
+
+
+def validate_order(orders: List[List[Action]], n_devices: int, n_virtual: int,
+                   n_microbatches: int) -> None:
+    """Structural validation: each (stage, microbatch) has exactly one F and
+    one B, F precedes B per device, and the tick scheduler completes."""
+    S = n_devices * n_virtual
+    seen: Dict[Action, int] = {}
+    for d, order in enumerate(orders):
+        pos = {}
+        for i, a in enumerate(order):
+            if a in seen:
+                raise ScheduleError(f"duplicate action {a}")
+            seen[a] = d
+            pos[a] = i
+        for a in order:
+            if a.op == B:
+                fa = Action(a.stage, F, a.microbatch)
+                if fa not in pos or pos[fa] > pos[a]:
+                    raise ScheduleError(f"backward before forward: {a}")
+    expect = 2 * S * n_microbatches
+    if len(seen) != expect:
+        raise ScheduleError(f"expected {expect} actions, got {len(seen)}")
+    schedule_ticks(orders, n_devices, n_virtual)  # raises on deadlock
+
+
+# ---------------------------------------------------------------------------
+# Tick tables for the SPMD executor
+# ---------------------------------------------------------------------------
+
+# Columns of the per-(tick, device) table. -1 means "no-op this tick".
+# Buffers are slot-addressed: slots are allocated from actual activation
+# lifetimes, so 1F1B keeps its O(in-flight) activation-memory advantage over
+# GPipe's O(M) instead of always allocating M microbatch buffers.
+COL_STORE_F_SLOT = 0  # store incoming fwd activation -> act_buf[slot]
+COL_FWD_V, COL_FWD_M, COL_FWD_SLOT = 1, 2, 3  # forward unit: (v, m), input slot
+COL_STORE_B_SLOT = 4  # store incoming grad -> grad_buf[slot]
+COL_BWD_V, COL_BWD_M = 5, 6  # backward unit: (v, m)
+COL_BWD_ASLOT, COL_BWD_GSLOT = 7, 8  # saved-input slot, incoming-grad slot
+N_COLS = 9
+
+
+@dataclasses.dataclass(frozen=True)
+class CompiledSchedule:
+    name: str
+    n_devices: int
+    n_virtual: int
+    n_microbatches: int
+    table: np.ndarray  # [T, D, N_COLS] int32
+    makespan: int
+    ticks: Dict[Action, int]
+    n_act_slots: int
+    n_grad_slots: int
+
+    @property
+    def n_stages(self) -> int:
+        return self.n_devices * self.n_virtual
+
+
+def _allocate_slots(events: List[Tuple[int, int, object]]) -> Tuple[Dict[object, int], int]:
+    """Greedy interval slot allocation.
+
+    ``events`` is a list of (store_tick, release_tick, key): the slot is
+    written at ``store_tick`` and may be reused for stores at
+    ``release_tick + 1`` onwards (release_tick is the tick whose compute
+    reads it last). Returns (key -> slot, n_slots).
+    """
+    by_store = sorted(events, key=lambda e: (e[0], e[1]))
+    free: List[int] = []
+    in_use: List[Tuple[int, int]] = []  # (release_tick, slot)
+    n_slots = 0
+    assign: Dict[object, int] = {}
+    for store, release, key in by_store:
+        while in_use and in_use[0][0] < store:
+            _, slot = heapq.heappop(in_use)
+            heapq.heappush(free, slot)
+        if free:
+            slot = heapq.heappop(free)
+        else:
+            slot = n_slots
+            n_slots += 1
+        assign[key] = slot
+        heapq.heappush(in_use, (release, slot))
+    return assign, n_slots
+
+
+def compile_schedule(name: str, n_devices: int, n_virtual: int,
+                     n_microbatches: int) -> CompiledSchedule:
+    """Generate, validate, and lower a schedule to executor tick tables.
+
+    The lowering is the SPMD analog of upstream's comm insertion
+    (``_add_send_recv`` / ``_prepare_schedule_with_comms``,
+    ``schedules.py:1406, 2279`` — SURVEY.md U5): instead of SEND/RECV actions,
+    every tick ends with a fwd ``ppermute`` (+1 ring) and a bwd ``ppermute``
+    (-1 ring), and the table records which arrivals carry real data and which
+    buffer slot holds each live value. The compiled table is self-checked by
+    :func:`verify_table` (a symbolic interpreter) before being returned.
+    """
+    D, V, M = n_devices, n_virtual, n_microbatches
+    orders = build_order(name, D, V, M)
+    validate_order(orders, D, V, M)
+    ticks, T_compute = schedule_ticks(orders, D, V)
+    S = D * V
+    # +1: arrivals land one tick after the producing compute; the final
+    # backward of stage 0 produces no arrival, but a last-tick forward of a
+    # non-final stage (never happens in practice) would need T_compute + 1.
+    T = T_compute + 1
+
+    # Activation lifetimes per device: input of stage s for microbatch m is
+    # written at the producer's tick + 1 (arrival) — or at the forward tick
+    # itself for global stage 0 (the embed is computed in place) — and last
+    # read by B(s, m). Grad lifetimes: written at B(s+1, m) + 1, read by B(s, m).
+    act_events: List[List[Tuple[int, int, object]]] = [[] for _ in range(D)]
+    grad_events: List[List[Tuple[int, int, object]]] = [[] for _ in range(D)]
+    for a, t in ticks.items():
+        if a.op != F:
+            continue
+        d = a.stage % D
+        store = t if a.stage == 0 else ticks[Action(a.stage - 1, F, a.microbatch)] + 1
+        release = ticks[Action(a.stage, B, a.microbatch)]
+        act_events[d].append((store, release, (a.stage, a.microbatch)))
+    for a, t in ticks.items():
+        if a.op != B or a.stage == S - 1:
+            continue
+        d = a.stage % D
+        store = ticks[Action(a.stage + 1, B, a.microbatch)] + 1
+        grad_events[d].append((store, t, (a.stage, a.microbatch)))
+
+    act_assign, n_act = [], 0
+    grad_assign, n_grad = [], 0
+    for d in range(D):
+        assign, n = _allocate_slots(act_events[d])
+        act_assign.append(assign)
+        n_act = max(n_act, n)
+        assign, n = _allocate_slots(grad_events[d])
+        grad_assign.append(assign)
+        n_grad = max(n_grad, n)
+    n_grad = max(n_grad, 1)  # executor buffers cannot be zero-sized
+
+    table = np.full((T, D, N_COLS), -1, dtype=np.int32)
+    for a, t in ticks.items():
+        d = a.stage % D
+        v = a.stage // D
+        if a.op == F:
+            slot = act_assign[d][(a.stage, a.microbatch)]
+            table[t, d, COL_FWD_V] = v
+            table[t, d, COL_FWD_M] = a.microbatch
+            table[t, d, COL_FWD_SLOT] = slot
+            if a.stage < S - 1:  # activation arrives at the next stage at t+1
+                nd = (a.stage + 1) % D
+                nslot = act_assign[nd][(a.stage + 1, a.microbatch)]
+                table[t + 1, nd, COL_STORE_F_SLOT] = nslot
+        else:
+            table[t, d, COL_BWD_V] = v
+            table[t, d, COL_BWD_M] = a.microbatch
+            table[t, d, COL_BWD_ASLOT] = act_assign[d][(a.stage, a.microbatch)]
+            if a.stage < S - 1:
+                table[t, d, COL_BWD_GSLOT] = grad_assign[d][(a.stage, a.microbatch)]
+            if a.stage > 0:  # grad arrives at the previous stage at t+1
+                pd = (a.stage - 1) % D
+                pslot = grad_assign[pd][(a.stage - 1, a.microbatch)]
+                table[t + 1, pd, COL_STORE_B_SLOT] = pslot
+    # Trim trailing all-empty ticks (keeps the executor scan minimal).
+    while T > 1 and np.all(table[T - 1] == -1):
+        T -= 1
+    cs = CompiledSchedule(name, D, V, M, table[:T], T, ticks, n_act, n_grad)
+    verify_table(cs)
+    return cs
+
+
+def verify_table(cs: CompiledSchedule) -> None:
+    """Symbolic interpreter over the compiled table: executes the exact
+    store/compute/permute contract the SPMD executor uses and checks that
+    every forward reads the right stage input and every backward reads the
+    right saved input and incoming cotangent. Raises ScheduleError on any
+    stale read, overwrite of a live value, or missing data."""
+    D, V, S = cs.n_devices, cs.n_virtual, cs.n_stages
+    act = [dict() for _ in range(D)]   # slot -> ("act", stage, mb)
+    grad = [dict() for _ in range(D)]  # slot -> ("gout", stage, mb)
+    fwd_in = [None] * D  # value delivered by last tick's +1 ppermute
+    bwd_in = [None] * D
+    fwd_done = set()
+    bwd_done = set()
+    for t in range(cs.table.shape[0]):
+        fwd_send = [None] * D
+        bwd_send = [None] * D
+        for d in range(D):
+            row = cs.table[t, d]
+            if row[COL_STORE_F_SLOT] >= 0:
+                if fwd_in[d] is None:
+                    raise ScheduleError(f"t={t} d={d}: fwd store of empty register")
+                act[d][int(row[COL_STORE_F_SLOT])] = fwd_in[d]
+            if row[COL_STORE_B_SLOT] >= 0:
+                if bwd_in[d] is None:
+                    raise ScheduleError(f"t={t} d={d}: bwd store of empty register")
+                grad[d][int(row[COL_STORE_B_SLOT])] = bwd_in[d]
+            if row[COL_FWD_M] >= 0:
+                s = int(row[COL_FWD_V]) * D + d
+                m = int(row[COL_FWD_M])
+                slot = int(row[COL_FWD_SLOT])
+                if s == 0:
+                    act[d][slot] = ("act", 0, m)  # embed computed in place
+                got = act[d].get(slot)
+                if got != ("act", s, m):
+                    raise ScheduleError(
+                        f"t={t} d={d}: F(stage={s}, mb={m}) read slot {slot} "
+                        f"holding {got}")
+                fwd_send[d] = ("act", s + 1, m)
+                fwd_done.add((s, m))
+            if row[COL_BWD_M] >= 0:
+                s = int(row[COL_BWD_V]) * D + d
+                m = int(row[COL_BWD_M])
+                aslot = int(row[COL_BWD_ASLOT])
+                got = act[d].get(aslot)
+                if got != ("act", s, m):
+                    raise ScheduleError(
+                        f"t={t} d={d}: B(stage={s}, mb={m}) saved-input slot "
+                        f"{aslot} holds {got}")
+                if s < S - 1:
+                    gslot = int(row[COL_BWD_GSLOT])
+                    gg = grad[d].get(gslot)
+                    if gg != ("gout", s, m):
+                        raise ScheduleError(
+                            f"t={t} d={d}: B(stage={s}, mb={m}) grad slot "
+                            f"{gslot} holds {gg}")
+                bwd_send[d] = ("gout", s - 1, m) if s > 0 else None
+                bwd_done.add((s, m))
+        fwd_in = [fwd_send[(d - 1) % D] for d in range(D)]
+        bwd_in = [bwd_send[(d + 1) % D] for d in range(D)]
+    want = {(s, m) for s in range(S) for m in range(cs.n_microbatches)}
+    if fwd_done != want or bwd_done != want:
+        raise ScheduleError("table does not execute every (stage, microbatch)")
+
+
+# ---------------------------------------------------------------------------
+# Bubble analytics
+# ---------------------------------------------------------------------------
+
+
+def analytic_bubble_fraction(name: str, n_devices: int, n_virtual: int,
+                             n_microbatches: int) -> float:
+    """Ideal bubble fraction in unit-cost ticks.
+
+    GPipe / 1F1B: (D-1)/(M + D - 1) — the classic fill/drain bubble (1F1B
+    matches GPipe's bubble; its win is activation memory, SURVEY.md §6 note).
+    Interleaved: warmup/cooldown offsets stay proportional to D-1 while
+    per-device work grows to 2MV ticks -> (D-1)/(M*V + D-1).
+    """
+    D, M = n_devices, n_microbatches
+    V = n_virtual if name == "Interleaved1F1B" else 1
+    return (D - 1) / (M * V + D - 1)
+
+
+def simulated_bubble(cs: CompiledSchedule, w_f: float = 1.0,
+                     w_b: float = 2.0) -> Dict[str, float]:
+    """Bubble measured on the compiled tick schedule under a cost model where
+    a forward tick costs ``w_f`` and a backward tick ``w_b`` (backward ~2x
+    forward; the executor's rematerializing backward is ~3x — pass w_b=3.0
+    for that model). Lockstep SPMD: each tick lasts as long as its most
+    expensive active device."""
+    T = cs.makespan
+    tick_cost = np.zeros(T + 1)
+    busy = np.zeros(cs.n_devices)
+    for a, t in cs.ticks.items():
+        w = w_f if a.op == F else w_b
+        d = a.stage % cs.n_devices
+        tick_cost[t] = max(tick_cost[t], w)
+        busy[d] += w
+    makespan = float(tick_cost.sum())
+    per_device = 1.0 - busy / makespan
+    return {
+        "makespan": makespan,
+        "bubble_fraction": float(per_device.mean()),
+        "bubble_fraction_max": float(per_device.max()),
+    }
