@@ -1,0 +1,69 @@
+"""Headline benchmark: pipeline training-step throughput on real hardware.
+
+Reproduces the reference's measurement semantics (SURVEY.md C4,
+``LLMsDistributedTrainingHelper.py:98-143``): the canonical mid config —
+ref_decoder L8/H8, batch 32, seq 128, 4 microbatches — timed over
+``num_iterations`` full schedule steps (forward + backward + inter-stage
+transfer, no optimizer) after 2 untimed warmup iterations; throughput =
+batch * seq * iters / elapsed in tokens/sec.
+
+Baseline: the reference's GPipe L8/H8 2-process run on 10-core CPU/gloo =
+1671.32 tok/s (BASELINE.md, notebook cell 25). Here the same schedule
+machinery runs on however many chips are visible (a 1-chip mesh degenerates
+to a self-ring but still executes the full tick program, remat backward and
+all).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import jax
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.models import transformer as tfm
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import make_mesh
+from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+    make_pipeline_step)
+
+BASELINE_TOKS_PER_SEC = 1671.32  # GPipe L8/H8 2 procs, reference cell 25
+
+
+def run(batch_size: int = 32, seq_length: int = 128, num_iterations: int = 20,
+        schedule: str = "GPipe", n_microbatches: int = 4) -> dict:
+    n_devices = len(jax.devices())
+    n_pipe = n_devices  # 1-D pipeline mesh over every visible chip
+    cfg = dtpp.ModelConfig()  # reference defaults: dim 768, L8, H8, vocab 10k
+    sched = dtpp.ScheduleConfig(name=schedule, n_microbatches=n_microbatches)
+    mesh = make_mesh(n_pipe=n_pipe)
+    step = make_pipeline_step(cfg, mesh, sched)
+
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (batch_size, seq_length),
+                                0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (batch_size, seq_length),
+                                 0, cfg.vocab_size)
+
+    for _ in range(2):  # warmup, untimed (reference :113-118)
+        jax.block_until_ready(step(params, tokens, targets))
+
+    start = time.perf_counter()
+    for _ in range(num_iterations):
+        loss, grads = step(params, tokens, targets)
+    jax.block_until_ready((loss, grads))
+    elapsed = time.perf_counter() - start
+
+    tokens_processed = batch_size * seq_length * num_iterations
+    throughput = tokens_processed / elapsed
+    return {
+        "metric": f"pipeline train-step throughput ({schedule}, L8/H8, "
+                  f"batch {batch_size}, seq {seq_length}, {n_pipe}-stage)",
+        "value": round(throughput, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(throughput / BASELINE_TOKS_PER_SEC, 3),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run()))
