@@ -1,0 +1,46 @@
+"""Timed training iterations + the reference's metrics dict.
+
+Parity with ``run_train_iterations`` (SURVEY.md C4,
+``LLMsDistributedTrainingHelper.py:98-143``): 2 untimed warmup iterations,
+``num_iterations`` timed schedule steps (forward + backward + inter-stage
+transfer, **no optimizer** — the reference never creates one, SURVEY.md §3.3
+note), throughput = batch * seq * iters / elapsed, and the same result dict
+``{"elapsed_time", "throughput", "tokens_processed"}``.
+
+In SPMD there is no rank-role dispatch (the reference feeds x on rank 0 and
+target=y on the last rank): every device runs the same program, and
+``jax.block_until_ready`` around the timed loop gives the honest wall-clock
+the reference gets from process joins.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict
+
+import jax
+
+
+def run_train_iterations(step: Callable, params, tokens, targets,
+                         num_iterations: int = 10,
+                         warmup_iterations: int = 2) -> Dict[str, float]:
+    """Time ``num_iterations`` pipeline steps after untimed warmup."""
+    total_toks = tokens.shape[0] * tokens.shape[1] * num_iterations
+
+    out = None
+    for _ in range(warmup_iterations):
+        out = step(params, tokens, targets)
+    if out is not None:
+        jax.block_until_ready(out)
+
+    start = time.perf_counter()
+    for _ in range(num_iterations):
+        out = step(params, tokens, targets)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - start
+
+    return {
+        "elapsed_time": elapsed,
+        "throughput": total_toks / elapsed,
+        "tokens_processed": total_toks,
+    }

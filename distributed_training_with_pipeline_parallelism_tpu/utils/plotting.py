@@ -1,0 +1,89 @@
+"""Result plots matching the reference notebook's figures (SURVEY.md C10).
+
+- :func:`plot_speedup_and_efficiency` — cell 28 (``.ipynb:863-943``): a 1x2
+  figure of speedup and scaling-efficiency lines vs model config ``L{n}_H{h}``,
+  color by schedule, marker by device count, with the GPipe = 1.0 / 100%
+  reference lines.
+- :func:`plot_throughput_grid` — cell 30 (``.ipynb:955-1004``): a 3x3 grid of
+  throughput-vs-device-count panels, one per (layers, heads).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import pandas as pd
+
+
+def _mpl():
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    return plt
+
+SCHEDULE_COLORS = {"GPipe": "tab:blue", "1F1B": "tab:orange",
+                   "Interleaved1F1B": "tab:green"}
+PROC_MARKERS = {2: "o", 4: "s", 8: "^", 16: "D"}
+
+
+def plot_speedup_and_efficiency(speedup_df: pd.DataFrame,
+                                path: Optional[str] = None):
+    plt = _mpl()
+    fig, (ax_s, ax_e) = plt.subplots(1, 2, figsize=(14, 5))
+    configs = sorted({(r.n_layers, r.n_heads)
+                      for r in speedup_df.itertuples()})
+    labels = [f"L{L}_H{H}" for L, H in configs]
+    xs = range(len(configs))
+    for schedule, g1 in speedup_df.groupby("schedule"):
+        for procs, g2 in g1.groupby("num_processes"):
+            lookup = {(r.n_layers, r.n_heads): r for r in g2.itertuples()}
+            ys_s = [lookup[c].speedup if c in lookup else None for c in configs]
+            ys_e = [lookup[c].efficiency if c in lookup else None for c in configs]
+            style = dict(color=SCHEDULE_COLORS.get(schedule),
+                         marker=PROC_MARKERS.get(procs, "x"),
+                         label=f"{schedule} ({procs} devices)")
+            ax_s.plot(xs, ys_s, **style)
+            ax_e.plot(xs, ys_e, **style)
+    ax_s.axhline(1.0, color="gray", linestyle="--", label="GPipe baseline")
+    ax_e.axhline(100.0, color="gray", linestyle="--")
+    for ax, title, ylabel in ((ax_s, "Speedup vs GPipe", "speedup"),
+                              (ax_e, "Scaling efficiency", "efficiency (%)")):
+        ax.set_xticks(list(xs))
+        ax.set_xticklabels(labels, rotation=45)
+        ax.set_xlabel("model configuration")
+        ax.set_ylabel(ylabel)
+        ax.set_title(title)
+        ax.grid(alpha=0.3)
+    ax_s.legend(fontsize=8)
+    fig.tight_layout()
+    if path:
+        fig.savefig(path, dpi=120)
+    return fig
+
+
+def plot_throughput_grid(df: pd.DataFrame, path: Optional[str] = None):
+    plt = _mpl()
+    layer_vals = sorted(df["n_layers"].unique())
+    head_vals = sorted(df["n_heads"].unique())
+    fig, axes = plt.subplots(len(layer_vals), len(head_vals),
+                             figsize=(4 * len(head_vals), 3.2 * len(layer_vals)),
+                             squeeze=False)
+    for i, L in enumerate(layer_vals):
+        for j, H in enumerate(head_vals):
+            ax = axes[i][j]
+            sub = df[(df["n_layers"] == L) & (df["n_heads"] == H)]
+            for schedule, g in sub.groupby("schedule"):
+                g = g.sort_values("num_processes")
+                ax.plot(g["num_processes"], g["throughput"],
+                        marker="o", color=SCHEDULE_COLORS.get(schedule),
+                        label=schedule)
+            ax.set_title(f"L{L}, H{H}", fontsize=10)
+            ax.set_xlabel("devices")
+            ax.set_ylabel("tokens/sec")
+            ax.grid(alpha=0.3)
+            if i == 0 and j == 0:
+                ax.legend(fontsize=8)
+    fig.tight_layout()
+    if path:
+        fig.savefig(path, dpi=120)
+    return fig

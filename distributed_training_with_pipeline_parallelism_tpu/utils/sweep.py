@@ -1,0 +1,148 @@
+"""Experiment sweep driver: the reference's 54-config harness, TPU-native.
+
+Parity targets (SURVEY.md C6-C9):
+
+- ``run_one_experiment`` (notebook cell 19, ``.ipynb:296-335``) — one config,
+  one metrics dict. The reference spawns ``num_processes`` fresh interpreters
+  rendezvousing over gloo; here a config is one jitted SPMD program over a
+  ``num_devices``-wide pipeline mesh, so "launch" is just compile + run.
+- ``run_all_experiments`` (cell 20, ``.ipynb:337-394``) — the full cross
+  product layers {4,8,12} x heads {4,8,12} x devices {2,4} x schedules
+  {GPipe, 1F1B, Interleaved1F1B} = 54 experiments, 5 timed iterations each,
+  batch 32, seq 128; per-experiment progress printing; errors logged and
+  skipped (same contract: a failed config contributes an ``error`` row and
+  the sweep continues).
+- ``compute_speedup_and_efficiency`` (cell 21, ``.ipynb:396-435``) —
+  ``speedup = throughput / GPipe throughput`` per (layers, heads, devices)
+  group; ``efficiency = speedup / devices * 100``.
+
+Additions over the reference (SURVEY.md §5 metrics row): analytic and
+simulated pipeline-bubble columns, and tokens/sec/chip.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Dict, Iterable, Optional, Sequence
+
+import pandas as pd
+
+from .config import ModelConfig, RunConfig, ScheduleConfig, virtual_stages_for
+from .metrics import run_train_iterations
+
+SCHEDULES = ("GPipe", "1F1B", "Interleaved1F1B")
+
+
+def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
+                       schedule_type: str, batch_size: int = 32,
+                       seq_length: int = 128, num_iterations: int = 5,
+                       dim: int = 768, vocab_size: int = 10000,
+                       n_microbatches: int = 4, seed: int = 0,
+                       arch: str = "ref_decoder",
+                       dtype: str = "float32") -> Dict[str, float]:
+    """Run one pipeline experiment; returns the reference's metrics dict plus
+    bubble analytics, or ``{"error": ...}`` on failure."""
+    import jax
+
+    from ..models.transformer import transformer_init
+    from ..parallel.mesh import make_mesh
+    from ..parallel.pipeline import make_pipeline_step
+    from ..parallel.schedules import (analytic_bubble_fraction,
+                                      compile_schedule, simulated_bubble)
+
+    try:
+        n_virtual = virtual_stages_for(schedule_type, n_layers, num_devices)
+        cfg = ModelConfig(dim=dim, n_layers=n_layers, n_heads=n_heads,
+                          vocab_size=vocab_size, arch=arch, dtype=dtype)
+        sched = ScheduleConfig(name=schedule_type,
+                               n_microbatches=n_microbatches,
+                               n_virtual=n_virtual)
+        mesh = make_mesh(n_pipe=num_devices)
+        step = make_pipeline_step(cfg, mesh, sched)
+
+        params = transformer_init(jax.random.key(seed), cfg)
+        kx, ky = jax.random.split(jax.random.key(seed + 1))
+        tokens = jax.random.randint(kx, (batch_size, seq_length), 0, vocab_size)
+        targets = jax.random.randint(ky, (batch_size, seq_length), 0, vocab_size)
+
+        metrics = run_train_iterations(step, params, tokens, targets,
+                                       num_iterations=num_iterations)
+        cs = compile_schedule(schedule_type, num_devices, n_virtual,
+                              n_microbatches)
+        # remat backward ~ 2 fwd-equivalents of grad work + 1 recompute
+        sim = simulated_bubble(cs, w_f=1.0, w_b=3.0)
+        metrics.update({
+            "throughput_per_chip": metrics["throughput"] / num_devices,
+            "n_virtual": n_virtual,
+            "bubble_analytic": analytic_bubble_fraction(
+                schedule_type, num_devices, n_virtual, n_microbatches),
+            "bubble_simulated": sim["bubble_fraction"],
+        })
+        return metrics
+    except Exception as e:  # same catch-all contract as the reference worker
+        traceback.print_exc()
+        return {"error": str(e)}
+
+
+def run_all_experiments(layers: Sequence[int] = (4, 8, 12),
+                        heads: Sequence[int] = (4, 8, 12),
+                        devices: Sequence[int] = (2, 4),
+                        schedules: Sequence[str] = SCHEDULES,
+                        batch_size: int = 32, seq_length: int = 128,
+                        num_iterations: int = 5,
+                        verbose: bool = True,
+                        **kwargs) -> pd.DataFrame:
+    """The reference's full cross-product sweep -> DataFrame (54 rows by
+    default). Failed configs are reported and skipped, not fatal."""
+    configs = [(L, H, D, s) for L in layers for H in heads
+               for D in devices for s in schedules]
+    rows = []
+    for k, (L, H, D, s) in enumerate(configs, 1):
+        if verbose:
+            print(f"[{k}/{len(configs)}] Running: layers={L} heads={H} "
+                  f"devices={D} schedule={s}", flush=True)
+        result = run_one_experiment(L, H, D, s, batch_size=batch_size,
+                                    seq_length=seq_length,
+                                    num_iterations=num_iterations, **kwargs)
+        if "error" in result:
+            if verbose:
+                print(f"    ERROR: {result['error']}", flush=True)
+            continue
+        if verbose:
+            print(f"    throughput: {result['throughput']:.2f} tokens/sec",
+                  flush=True)
+        rows.append({
+            "n_layers": L, "n_heads": H, "num_processes": D, "schedule": s,
+            **result,
+        })
+    return pd.DataFrame(rows)
+
+
+def compute_speedup_and_efficiency(df: pd.DataFrame) -> pd.DataFrame:
+    """Per (layers, heads, devices) group: speedup of each schedule over
+    GPipe; scaling efficiency = speedup / devices * 100 (the problem-set
+    formula, notebook cell 21)."""
+    rows = []
+    for (L, H, D), g in df.groupby(["n_layers", "n_heads", "num_processes"]):
+        gp = g[g["schedule"] == "GPipe"]
+        if gp.empty:
+            continue
+        base = float(gp["throughput"].iloc[0])
+        for schedule in ("1F1B", "Interleaved1F1B"):
+            row = g[g["schedule"] == schedule]
+            if row.empty:
+                continue
+            speedup = float(row["throughput"].iloc[0]) / base
+            rows.append({
+                "n_layers": L, "n_heads": H, "num_processes": D,
+                "schedule": schedule, "speedup": speedup,
+                "efficiency": speedup / D * 100.0,
+            })
+    return pd.DataFrame(rows)
+
+
+def pivot_throughput(df: pd.DataFrame) -> pd.DataFrame:
+    """Cell-25-style pivot: throughput by (layers, heads) x (schedule, devices)."""
+    return df.pivot_table(index=["n_layers", "n_heads"],
+                          columns=["schedule", "num_processes"],
+                          values="throughput")

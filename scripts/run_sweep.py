@@ -1,0 +1,63 @@
+"""Run the reference's full 54-config sweep and write tables + plots.
+
+Usage:
+    python scripts/run_sweep.py --simulate-devices 8        # CPU-simulated mesh
+    python scripts/run_sweep.py                             # real chips
+    python scripts/run_sweep.py --quick                     # 6-config smoke run
+
+Produces results/sweep.csv, results/speedup.csv, results/speedup.png,
+results/throughput_grid.png — the same tables/plots as notebook cells 25-30.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--simulate-devices", type=int, default=0,
+                    help="simulate N CPU devices (the JAX analog of the "
+                         "reference's gloo-on-localhost trick)")
+    ap.add_argument("--quick", action="store_true",
+                    help="small model / fewer configs for a smoke run")
+    ap.add_argument("--out", default="results")
+    ap.add_argument("--iterations", type=int, default=5)
+    args = ap.parse_args()
+
+    if args.simulate_devices:
+        os.environ["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={args.simulate_devices} "
+            + os.environ.get("XLA_FLAGS", ""))
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from distributed_training_with_pipeline_parallelism_tpu.utils.plotting import (
+        plot_speedup_and_efficiency, plot_throughput_grid)
+    from distributed_training_with_pipeline_parallelism_tpu.utils.sweep import (
+        compute_speedup_and_efficiency, pivot_throughput, run_all_experiments)
+
+    kwargs = {}
+    if args.quick:
+        kwargs = dict(layers=(4,), heads=(4, 8), devices=(2,),
+                      batch_size=8, seq_length=32, dim=64, vocab_size=256)
+    df = run_all_experiments(num_iterations=args.iterations, **kwargs)
+
+    os.makedirs(args.out, exist_ok=True)
+    df.to_csv(os.path.join(args.out, "sweep.csv"), index=False)
+    sp = compute_speedup_and_efficiency(df)
+    sp.to_csv(os.path.join(args.out, "speedup.csv"), index=False)
+    print("\n== Throughput pivot (tokens/sec) ==")
+    print(pivot_throughput(df).round(2).to_string())
+    print("\n== Speedup / efficiency ==")
+    print(sp.round(3).to_string(index=False))
+    if not sp.empty:
+        plot_speedup_and_efficiency(sp, os.path.join(args.out, "speedup.png"))
+    plot_throughput_grid(df, os.path.join(args.out, "throughput_grid.png"))
+    print(f"\nwrote {args.out}/sweep.csv, speedup.csv, *.png")
+
+
+if __name__ == "__main__":
+    main()

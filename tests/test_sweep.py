@@ -1,0 +1,72 @@
+"""Sweep-harness tests: mini cross-product sweep, derived metrics, plots."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_training_with_pipeline_parallelism_tpu.utils.sweep import (
+    compute_speedup_and_efficiency, pivot_throughput, run_all_experiments,
+    run_one_experiment)
+from distributed_training_with_pipeline_parallelism_tpu.utils import plotting
+
+
+@pytest.fixture(scope="module")
+def mini_sweep_df():
+    # Tiny model, all three schedules, 2 and 4 devices (simulated CPU mesh).
+    df = run_all_experiments(layers=(4,), heads=(4,), devices=(2, 4),
+                             batch_size=8, seq_length=16, num_iterations=2,
+                             dim=32, vocab_size=64, verbose=False)
+    return df
+
+
+def test_sweep_schema(mini_sweep_df):
+    df = mini_sweep_df
+    assert len(df) == 6  # 1 layer x 1 head x 2 devices x 3 schedules
+    for col in ("n_layers", "n_heads", "num_processes", "schedule",
+                "elapsed_time", "throughput", "tokens_processed",
+                "throughput_per_chip", "bubble_analytic", "bubble_simulated"):
+        assert col in df.columns, col
+    assert (df["tokens_processed"] == 8 * 16 * 2).all()
+    assert (df["throughput"] > 0).all()
+
+
+def test_interleaved_virtual_stage_rule(mini_sweep_df):
+    df = mini_sweep_df
+    il = df[df["schedule"] == "Interleaved1F1B"].set_index("num_processes")
+    # L=4, D=2: 4 % (2*2) == 0 -> 2 virtual stages; D=4: 4 % 8 != 0 -> 1
+    assert il.loc[2, "n_virtual"] == 2
+    assert il.loc[4, "n_virtual"] == 1
+
+
+def test_speedup_and_efficiency(mini_sweep_df):
+    sp = compute_speedup_and_efficiency(mini_sweep_df)
+    assert len(sp) == 4  # 2 schedules x 2 device counts
+    for r in sp.itertuples():
+        assert r.efficiency == pytest.approx(r.speedup / r.num_processes * 100)
+    # sanity: speedups are in a plausible band (not zero/inf)
+    assert sp["speedup"].between(0.05, 20).all()
+
+
+def test_pivot_table(mini_sweep_df):
+    pv = pivot_throughput(mini_sweep_df)
+    assert pv.shape == (1, 6)
+
+
+def test_error_contract():
+    # impossible config: n_layers not divisible into stages
+    out = run_one_experiment(n_layers=5, n_heads=4, num_devices=2,
+                             schedule_type="GPipe", batch_size=4,
+                             seq_length=8, num_iterations=1, dim=32,
+                             vocab_size=64)
+    assert "error" in out
+
+
+def test_plots(mini_sweep_df, tmp_path):
+    sp = compute_speedup_and_efficiency(mini_sweep_df)
+    p1 = tmp_path / "speedup.png"
+    p2 = tmp_path / "grid.png"
+    plotting.plot_speedup_and_efficiency(sp, str(p1))
+    plotting.plot_throughput_grid(mini_sweep_df, str(p2))
+    assert p1.stat().st_size > 0 and p2.stat().st_size > 0
