@@ -1,0 +1,28 @@
+"""Llama family configurations (BASELINE.json config ladder entries 4-5:
+"Llama-2 7B, 1F1B across v5e-16", "Llama-3 8B, Interleaved-1F1B x DP on
+v5p-64 2-D mesh").
+"""
+
+from __future__ import annotations
+
+from ..utils.config import ModelConfig
+
+
+def llama_config(name: str = "llama2-7b", **overrides) -> ModelConfig:
+    sizes = {
+        # dim, layers, heads, kv_heads, ffn, vocab, rope_theta
+        "llama2-7b": dict(dim=4096, n_layers=32, n_heads=32, n_kv_heads=32,
+                          ffn_dim=11008, vocab_size=32000, rope_theta=1e4),
+        "llama2-13b": dict(dim=5120, n_layers=40, n_heads=40, n_kv_heads=40,
+                           ffn_dim=13824, vocab_size=32000, rope_theta=1e4),
+        "llama3-8b": dict(dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+                          ffn_dim=14336, vocab_size=128256, rope_theta=5e5),
+        # scaled-down variant with the same shape ratios for tests/benches
+        "llama-debug": dict(dim=256, n_layers=8, n_heads=8, n_kv_heads=4,
+                            ffn_dim=688, vocab_size=1024, rope_theta=1e4),
+    }
+    if name not in sizes:
+        raise ValueError(f"unknown Llama size {name!r}; options: {sorted(sizes)}")
+    kw = dict(max_seq_len=4096, arch="llama", rms_eps=1e-5, **sizes[name])
+    kw.update(overrides)
+    return ModelConfig(**kw)

@@ -101,20 +101,18 @@ def unstack_stage_layers(stacked: Pytree) -> Pytree:
 # ---------------------------------------------------------------------------
 
 
-def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
-                       ) -> Callable[[Pytree, jax.Array, jax.Array],
-                                     Tuple[jax.Array, Pytree]]:
-    """Build a jitted training step ``(params, tokens, targets) -> (loss, grads)``.
+def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
+                          ) -> Callable[[Pytree, jax.Array, jax.Array],
+                                        Tuple[jax.Array, Pytree]]:
+    """Build an (unjitted) ``(params, tokens, targets) -> (loss, grads)``
+    pipeline step — compose with an optimizer under one jit (see
+    :mod:`..utils.train`) or jit directly via :func:`make_pipeline_step`.
 
     ``params`` is the full-model pytree from ``transformer_init``; ``grads``
     comes back in the same layout. ``tokens``/``targets`` are ``[B, S]`` with
     ``B`` divisible by (n_data * n_microbatches); the batch is split over the
     'data' mesh axis, then into microbatches along dim 0 (upstream
     ``DEFAULT_CHUNK_DIM=0``, ``microbatch.py:57``).
-
-    Matching the reference's measurement semantics (SURVEY.md §3.3 note): the
-    step computes loss and gradients only — no optimizer update — so it can be
-    timed exactly like ``schedule.step``. Compose with optax externally.
     """
     D = mesh.shape[PIPE_AXIS]
     n_data = mesh.shape.get(DATA_AXIS, 1)
@@ -273,7 +271,6 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         out_specs=(P(), P(PIPE_AXIS), P(), P()),
     )
 
-    @jax.jit
     def step(params, tokens, targets):
         stacked = stack_stage_layers(params["layers"], D, V)
         loss, g_layers, g_embed, g_head = sharded(
@@ -286,3 +283,15 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         return loss, grads
 
     return step
+
+
+def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
+                       ) -> Callable[[Pytree, jax.Array, jax.Array],
+                                     Tuple[jax.Array, Pytree]]:
+    """Jitted ``(params, tokens, targets) -> (loss, grads)`` pipeline step.
+
+    Matching the reference's measurement semantics (SURVEY.md §3.3 note): the
+    step computes loss and gradients only — no optimizer update — so it can be
+    timed exactly like ``schedule.step``.
+    """
+    return jax.jit(make_pipeline_grad_fn(cfg, mesh, sched))

@@ -1,0 +1,40 @@
+"""Checkpoint save/restore via Orbax.
+
+The reference has no checkpointing at all (SURVEY.md §5: no
+state_dict/save/load anywhere — models are random-initialized per experiment
+and discarded); this exists for the real-model ladder (GPT-2/Llama configs),
+which at minimum needs parameter loading.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+Pytree = Any
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(path: str, state: Pytree) -> None:
+    """Save a pytree (params, or {'params': ..., 'opt_state': ...}) to
+    ``path`` (created; must not already contain a checkpoint)."""
+    ckpt = _checkpointer()
+    ckpt.save(os.path.abspath(path), state)
+    ckpt.wait_until_finished()
+
+
+def restore_checkpoint(path: str, template: Optional[Pytree] = None) -> Pytree:
+    """Restore a pytree saved by :func:`save_checkpoint`. ``template`` (a
+    matching pytree of arrays or ShapeDtypeStructs) restores with the right
+    structure/dtypes/shardings; without it, orbax restores as saved."""
+    import jax
+    ckpt = _checkpointer()
+    if template is not None:
+        target = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template)
+        return ckpt.restore(os.path.abspath(path), target)
+    return ckpt.restore(os.path.abspath(path))

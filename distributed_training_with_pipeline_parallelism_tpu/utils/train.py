@@ -1,0 +1,93 @@
+"""Optimizer-coupled training on top of the pipeline executor.
+
+The reference *measures* forward+backward only — no ``optim.step()`` exists
+anywhere in it (SURVEY.md §3.3 note) — so the benchmark path
+(:func:`..parallel.pipeline.make_pipeline_step`) stays optimizer-free for
+parity. Real training on the model ladder (GPT-2 / Llama configs) composes
+the same pipeline gradients with an optax optimizer under a single jit here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from ..parallel.pipeline import make_pipeline_grad_fn
+from .config import ModelConfig, ScheduleConfig
+
+Pytree = Any
+
+
+def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
+                    optimizer: optax.GradientTransformation,
+                    ) -> Callable[[Pytree, Any, jax.Array, jax.Array],
+                                  Tuple[Pytree, Any, jax.Array]]:
+    """Jitted ``(params, opt_state, tokens, targets) ->
+    (params, opt_state, loss)``: pipeline grads + optax update in one XLA
+    program (so the update fuses with the grad psum epilogue)."""
+    grad_fn = make_pipeline_grad_fn(cfg, mesh, sched)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = grad_fn(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def adamw(learning_rate: float = 3e-4, weight_decay: float = 0.01,
+          warmup_steps: int = 100, total_steps: int = 10000,
+          max_grad_norm: float = 1.0) -> optax.GradientTransformation:
+    """Standard LM recipe: global-norm clip + AdamW + linear-warmup cosine."""
+    lr = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=learning_rate, warmup_steps=warmup_steps,
+        decay_steps=max(total_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(max_grad_norm),
+        optax.adamw(lr, weight_decay=weight_decay),
+    )
+
+
+def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
+        data: Iterator[Tuple[jax.Array, jax.Array]], num_steps: int,
+        optimizer: Optional[optax.GradientTransformation] = None,
+        log_every: int = 10, verbose: bool = True):
+    """Minimal training loop over a ``(tokens, targets)`` iterator.
+
+    Returns (params, list of (step, loss)). The data contract matches the
+    reference's synthetic setup (random token batches,
+    ``LLMsDistributedTrainingHelper.py:191-194``) but accepts any iterator.
+    """
+    optimizer = optimizer or adamw(total_steps=num_steps)
+    step_fn = make_train_step(cfg, mesh, sched, optimizer)
+    opt_state = optimizer.init(params)
+    history = []
+    for i in range(num_steps):
+        tokens, targets = next(data)
+        params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
+        if i % log_every == 0 or i == num_steps - 1:
+            loss_f = float(loss)
+            history.append((i, loss_f))
+            if verbose:
+                print(f"step {i}: loss {loss_f:.4f}", flush=True)
+    return params, history
+
+
+def synthetic_data(cfg: ModelConfig, batch_size: int, seq_length: int,
+                   seed: int = 0) -> Iterator[Tuple[jax.Array, jax.Array]]:
+    """Random-token batches, the reference's data regime. Targets are the
+    inputs shifted by one (next-token prediction), unlike the reference's
+    independent random targets — random targets make loss a constant-entropy
+    floor, which is useless for verifying that optimization works."""
+    key = jax.random.key(seed)
+    while True:
+        key, k = jax.random.split(key)
+        toks = jax.random.randint(k, (batch_size, seq_length + 1), 0,
+                                  cfg.vocab_size)
+        yield toks[:, :-1], toks[:, 1:]
