@@ -19,6 +19,15 @@ from jax.sharding import Mesh
 
 DATA_AXIS = "data"
 PIPE_AXIS = "pipe"
+SEQ_AXIS = "seq"
+
+
+def make_sp_mesh(n_seq: int, devices=None) -> "Mesh":
+    """1-D sequence-parallel mesh for ring attention."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n_seq:
+        raise ValueError(f"need {n_seq} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n_seq]), (SEQ_AXIS,))
 
 
 def make_mesh(n_pipe: int, n_data: int = 1,

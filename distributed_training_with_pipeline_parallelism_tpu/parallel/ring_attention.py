@@ -1,0 +1,122 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+Long-context support the reference does not have (SURVEY.md §5 long-context
+row: fixed seq 128, no CP/ring/Ulysses anywhere) but a TPU-native framework
+treats as first-class: the sequence dimension is sharded over a 'seq' mesh
+axis, each device holds one K/V chunk, and K/V chunks rotate around the ICI
+ring with ``jax.lax.ppermute`` while every device accumulates its queries'
+attention with a numerically-stable online softmax (the blockwise/flash
+recurrence of Liu et al., arXiv:2310.01889; Dao et al., arXiv:2205.14135).
+
+Memory per device is O(seq/D) activations; compute overlaps with the ring
+transfer (XLA pipelines the next chunk's ppermute with the current block's
+matmuls since they are independent in the dataflow graph). Gradients come
+from plain ``jax.grad`` — ``ppermute``'s transpose is the reverse-ring
+``ppermute``, so the backward pass is itself a ring pass.
+
+All math below runs inside ``shard_map``; use :func:`ring_mha_apply` as a
+drop-in for ``ops.attention.mha_apply`` when the sequence axis is sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import apply_rope, rope_frequencies
+from ..ops.layers import linear_apply
+
+NEG_INF = -1e30
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
+                   causal: bool = False) -> jax.Array:
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    q, k, v: [batch, seq_local, heads, head_dim] (per-device shards; K/V head
+    count may differ from Q's for GQA — repeat before calling). Returns the
+    attention output for the local query chunk, identical (up to float
+    associativity) to unsharded attention over the full sequence.
+    """
+    D = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s_q, h, dh = q.shape
+    s_kv = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32))
+    perm = [(i, (i + 1) % D) for i in range(D)]
+
+    qf = q.astype(jnp.float32)
+
+    def block_update(carry, kv_and_src):
+        m, l, o, k_cur, v_cur, src = carry
+        # scores for this block: [b, h, s_q, s_kv] in f32
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32)) * scale
+        if causal:
+            iq = jnp.arange(s_q)[:, None] + my * s_q
+            ik = jnp.arange(s_kv)[None, :] + src * s_kv
+            s = jnp.where((iq >= ik)[None, None], s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1)  # [b, h, s_q]
+        m_new = jnp.maximum(m, m_blk)
+        # guard fully-masked rows (exp(NEG_INF - NEG_INF) would be 1)
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m - m_new)
+        alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = (o * alpha[..., None]
+                 + jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)))
+        # rotate K/V to the next device; chunk provenance rotates with it
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        src_nxt = jax.lax.ppermute(src, axis_name, perm)
+        return (m_new, l_new, o_new, k_nxt, v_nxt, src_nxt), None
+
+    m0 = jnp.full((b, h, s_q), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_q), jnp.float32)
+    o0 = jnp.zeros((b, h, s_q, dh), jnp.float32)
+    carry = (m0, l0, o0, k, v, my)
+    carry, _ = jax.lax.scan(block_update, carry, None, length=D)
+    _, l, o, _, _, _ = carry
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows (never happens for causal q>=0)
+    out = (o / l[..., None]).transpose(0, 2, 1, 3)  # [b, s_q, h, dh]
+    return out.astype(q.dtype)
+
+
+def ring_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
+                   n_heads: int, axis_name: str, causal: bool = False,
+                   rope_angles: Optional[jax.Array] = None) -> jax.Array:
+    """Sequence-parallel drop-in for ``ops.attention.mha_apply``: projections
+    are local (they are position-wise), attention runs over the ring.
+
+    ``rope_angles`` must already be sliced to this device's global positions
+    (see :func:`local_rope_angles`).
+    """
+    head_dim = params["q"]["w"].shape[1] // n_heads
+    n_kv = params["k"]["w"].shape[1] // head_dim
+    b, s, _ = q_in.shape
+
+    def split(x, n):
+        return x.reshape(b, -1, n, head_dim)
+
+    q = split(linear_apply(params["q"], q_in), n_heads)
+    k = split(linear_apply(params["k"], kv_in), n_kv)
+    v = split(linear_apply(params["v"], kv_in), n_kv)
+    if rope_angles is not None:
+        q = apply_rope(q, rope_angles)
+        k = apply_rope(k, rope_angles)
+    if n_kv != n_heads:
+        rep = n_heads // n_kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    out = ring_attention(q, k, v, axis_name, causal=causal)
+    return linear_apply(params["o"], out.reshape(b, s, -1))
+
+
+def local_rope_angles(cfg, seq_local: int, axis_name: str) -> jax.Array:
+    """RoPE angles for this device's global position range."""
+    my = jax.lax.axis_index(axis_name)
+    D = jax.lax.psum(1, axis_name)
+    full = rope_frequencies(cfg.head_dim, seq_local * D, cfg.rope_theta)
+    return jax.lax.dynamic_slice_in_dim(full, my * seq_local, seq_local, axis=0)

@@ -1,0 +1,100 @@
+"""Sequence-parallel training path: full model with ring attention.
+
+Shards the *sequence* dimension of activations over a 'seq' mesh axis —
+embeddings, LayerNorms and MLPs are position-wise (purely local), and
+attention runs over the ICI ring (:mod:`.ring_attention`). Loss and grads
+are exact: identical to the unsharded model up to float associativity.
+
+This is the long-context scaling story the reference lacks entirely
+(SURVEY.md §5: fixed seq 128, no sequence parallelism of any kind). It
+composes with data parallelism (add a 'data' axis) and is orthogonal to the
+pipeline executor.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.transformer import ModelConfig
+from ..ops.layers import (cross_entropy_loss, embedding_apply,
+                          layer_norm_apply, linear_apply, rms_norm_apply)
+from .mesh import SEQ_AXIS
+from .pipeline import _shard_map
+from .ring_attention import local_rope_angles, ring_mha_apply
+
+Pytree = Any
+
+
+def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
+                   rope_angles) -> jax.Array:
+    """Sequence-sharded twin of ``models.transformer.layer_apply``."""
+    if cfg.arch == "ref_decoder":
+        mem = h
+        x = layer_norm_apply(params["ln1"],
+                             h + ring_mha_apply(params["self_attn"], h, h,
+                                                cfg.n_heads, axis_name))
+        x = layer_norm_apply(params["ln2"],
+                             x + ring_mha_apply(params["cross_attn"], x, mem,
+                                                cfg.n_heads, axis_name))
+        ff = linear_apply(params["lin2"], jax.nn.relu(linear_apply(params["lin1"], x)))
+        return layer_norm_apply(params["ln3"], x + ff)
+    if cfg.arch == "gpt2":
+        a = layer_norm_apply(params["ln1"], h)
+        h = h + ring_mha_apply(params["attn"], a, a, cfg.n_heads, axis_name,
+                               causal=True)
+        m = layer_norm_apply(params["ln2"], h)
+        return h + linear_apply(params["lin2"],
+                                jax.nn.gelu(linear_apply(params["lin1"], m)))
+    if cfg.arch == "llama":
+        a = rms_norm_apply(params["rms1"], h, cfg.rms_eps)
+        h = h + ring_mha_apply(params["attn"], a, a, cfg.n_heads, axis_name,
+                               causal=True, rope_angles=rope_angles)
+        m = rms_norm_apply(params["rms2"], h, cfg.rms_eps)
+        ff = linear_apply(params["w2"],
+                          jax.nn.silu(linear_apply(params["w1"], m))
+                          * linear_apply(params["w3"], m))
+        return h + ff
+    raise ValueError(f"unknown arch {cfg.arch!r}")
+
+
+def make_sp_loss_fn(cfg: ModelConfig, mesh: Mesh,
+                    ) -> Callable[[Pytree, jax.Array, jax.Array], jax.Array]:
+    """Sequence-parallel loss: ``(params, tokens, targets) -> scalar``.
+    Differentiable — wrap in ``jax.value_and_grad`` (+jit) for training;
+    shard_map's transpose rules turn the forward ring into a backward ring."""
+    D = mesh.shape[SEQ_AXIS]
+
+    def spmd_loss(params, tokens, targets):
+        # tokens/targets arrive as [B, S/D] local chunks
+        my = jax.lax.axis_index(SEQ_AXIS)
+        s_local = tokens.shape[1]
+        h = embedding_apply(params["embed"]["tok"], tokens)
+        if cfg.arch == "gpt2":
+            pos = jax.lax.dynamic_slice_in_dim(
+                params["embed"]["pos"], my * s_local, s_local, axis=0)
+            h = h + pos
+        h = h.astype(jnp.dtype(cfg.dtype))
+        rope = (local_rope_angles(cfg, s_local, SEQ_AXIS)
+                if cfg.arch == "llama" else None)
+
+        def step(carry, layer_params):
+            return sp_layer_apply(cfg, layer_params, carry, SEQ_AXIS, rope), None
+
+        h, _ = jax.lax.scan(step, h, params["layers"])
+        if cfg.arch == "llama":
+            h = rms_norm_apply(params["head"]["norm"], h, cfg.rms_eps)
+        else:
+            h = layer_norm_apply(params["head"]["norm"], h)
+        logits = linear_apply(params["head"]["out"], h)
+        local = cross_entropy_loss(logits, targets)  # mean over local tokens
+        return jax.lax.psum(local, SEQ_AXIS) / D  # equal chunks -> global mean
+
+    return _shard_map(
+        spmd_loss, mesh,
+        in_specs=(P(), P(None, SEQ_AXIS), P(None, SEQ_AXIS)),
+        out_specs=P(),
+    )
