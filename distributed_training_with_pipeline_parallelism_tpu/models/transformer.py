@@ -72,21 +72,22 @@ def layer_init(key: jax.Array, cfg: ModelConfig) -> Dict:
 
 def layer_apply(cfg: ModelConfig, params: Dict, h: jax.Array,
                 rope_angles: Optional[jax.Array] = None) -> jax.Array:
+    fl = cfg.use_flash_attention
     if cfg.arch == "ref_decoder":
         mem = h  # the reference calls layer(h, h): memory is the layer's input
-        x = layer_norm_apply(params["ln1"], h + mha_apply(params["self_attn"], h, h, cfg.n_heads))
-        x = layer_norm_apply(params["ln2"], x + mha_apply(params["cross_attn"], x, mem, cfg.n_heads))
+        x = layer_norm_apply(params["ln1"], h + mha_apply(params["self_attn"], h, h, cfg.n_heads, flash=fl))
+        x = layer_norm_apply(params["ln2"], x + mha_apply(params["cross_attn"], x, mem, cfg.n_heads, flash=fl))
         ff = linear_apply(params["lin2"], jax.nn.relu(linear_apply(params["lin1"], x)))
         return layer_norm_apply(params["ln3"], x + ff)
     if cfg.arch == "gpt2":
         a = layer_norm_apply(params["ln1"], h)
-        h = h + mha_apply(params["attn"], a, a, cfg.n_heads, causal=cfg.causal)
+        h = h + mha_apply(params["attn"], a, a, cfg.n_heads, causal=cfg.causal, flash=fl)
         m = layer_norm_apply(params["ln2"], h)
         return h + linear_apply(params["lin2"], jax.nn.gelu(linear_apply(params["lin1"], m)))
     if cfg.arch == "llama":
         a = rms_norm_apply(params["rms1"], h, cfg.rms_eps)
         h = h + mha_apply(params["attn"], a, a, cfg.n_heads, causal=cfg.causal,
-                          rope_angles=rope_angles)
+                          rope_angles=rope_angles, flash=fl)
         m = rms_norm_apply(params["rms2"], h, cfg.rms_eps)
         ff = linear_apply(params["w2"],
                           jax.nn.silu(linear_apply(params["w1"], m)) * linear_apply(params["w3"], m))

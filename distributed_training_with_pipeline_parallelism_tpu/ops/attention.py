@@ -55,8 +55,13 @@ def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
 
 
 def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
-              causal: bool = False, rope_angles: Optional[jax.Array] = None) -> jax.Array:
-    """Attention: queries from ``q_in``, keys/values from ``kv_in`` (both [b, s, d])."""
+              causal: bool = False, rope_angles: Optional[jax.Array] = None,
+              flash: bool = False) -> jax.Array:
+    """Attention: queries from ``q_in``, keys/values from ``kv_in`` (both [b, s, d]).
+
+    ``flash=True`` routes the core attention through the fused Pallas kernel
+    (:mod:`.pallas_attention`) instead of dense XLA softmax-matmuls.
+    """
     head_dim = params["q"]["w"].shape[1] // n_heads
     n_kv = params["k"]["w"].shape[1] // head_dim
     q = _split_heads(linear_apply(params["q"], q_in), n_heads)
@@ -69,13 +74,17 @@ def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
         rep = n_heads // n_kv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=q.dtype))
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    if causal:
-        s = q_in.shape[1]
-        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
-        scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    if flash:
+        from .pallas_attention import flash_attention
+        out = flash_attention(q, k, v, causal=causal)
+    else:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=q.dtype))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if causal:
+            s = q_in.shape[1]
+            mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+            scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
     out = out.reshape(q_in.shape[0], q_in.shape[1], -1)
     return linear_apply(params["o"], out)

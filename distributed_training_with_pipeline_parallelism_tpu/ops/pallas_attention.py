@@ -1,0 +1,151 @@
+"""Fused flash-attention kernel in Pallas (Mosaic) for TPU.
+
+This is the framework's native-kernel layer — the TPU analog of the C++/ATen
+kernels the reference leans on through torch (SURVEY.md §2.3: "if a custom
+native kernel layer is wanted ... it is Pallas (Mosaic) kernels"). The kernel
+computes softmax(QK^T/sqrt(d))V one query block at a time with the online
+softmax recurrence (Dao et al., arXiv:2205.14135), so the [s, s] score matrix
+never hits HBM: per grid step it lives in VMEM as a [block_q, block_k] tile
+feeding the MXU.
+
+Layout: the grid is (batch*heads, seq/block_q); each kernel instance holds
+its query block plus the full K/V for that (batch, head) in VMEM and loops
+over K/V blocks with ``jax.lax.fori_loop`` + ``pl.ds`` dynamic slices.
+Causal masking prunes the loop to blocks at or below the diagonal.
+
+Training support: ``flash_attention`` carries a ``jax.custom_vjp`` whose
+backward recomputes attention blockwise in plain XLA (flash-style
+rematerialization of the forward, dense [s, s] scores per (b, h) tile in the
+bwd matmuls — exact, memory-bounded by the backward tile, not by the kernel).
+On non-TPU backends the kernel runs in interpreter mode so CPU CI exercises
+the same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    plat = jax.devices()[0].platform
+    return plat not in ("tpu", "axon")
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                      causal: bool, scale: float, seq_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, dh]
+    block_q = q.shape[0]
+    dh = q.shape[1]
+
+    n_kv = pl.cdiv(seq_len, block_k)
+    if causal:
+        # highest k block that the last query row of this block can see
+        n_kv_live = jax.lax.min(n_kv, ((qi + 1) * block_q + block_k - 1) // block_k)
+    else:
+        n_kv_live = n_kv
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [bq, bk]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_blk = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m - m_new)
+        alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot(p, v)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, dh), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_kv_live, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
+               block_q: int, block_k: int) -> jax.Array:
+    """q, k, v: [bh, s, dh] -> [bh, s, dh]."""
+    bh, s, dh = q.shape
+    scale = 1.0 / (dh ** 0.5)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    grid = (bh, pl.cdiv(s, block_q))
+    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
+                               causal=causal, scale=scale, seq_len=s)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s, dh), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, dh), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),
+        interpret=_use_interpret(),
+    )(q, k, v)
+
+
+def _dense_attention(q, k, v, causal):
+    """Reference/backward path in plain XLA (f32 accumulation)."""
+    dh = q.shape[-1]
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / (dh ** 0.5)
+    if causal:
+        n, nk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(n)[:, None] >= jnp.arange(nk)[None, :]
+        s = jnp.where(mask[None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, block_q, block_k)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _dense_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, block_q: int = 256,
+                    block_k: int = 256) -> jax.Array:
+    """Fused attention: q, k, v [batch, seq, heads, head_dim] -> same shape.
+
+    Drop-in replacement for the dense attention inside
+    ``ops.attention.mha_apply`` (GQA repeat must happen before the call).
+    """
+    b, s, h, dh = q.shape
+
+    def flat(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, dh)
+
+    out = _flash(flat(q), flat(k), flat(v), causal, block_q, block_k)
+    return out.reshape(b, h, s, dh).transpose(0, 2, 1, 3)

@@ -42,6 +42,7 @@ class ModelConfig:
     # we default to 0.0 for determinism (loss values are never asserted by the
     # reference — only throughput — so this does not affect parity).
     dtype: str = "float32"
+    use_flash_attention: bool = False  # route attention through the Pallas kernel
     # Llama-only knobs.
     n_kv_heads: Optional[int] = None
     rope_theta: float = 10000.0
