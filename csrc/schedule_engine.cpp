@@ -1,0 +1,299 @@
+// Native schedule-compilation engine.
+//
+// C++ twin of parallel/schedules.py: per-device action-order generation for
+// GPipe / 1F1B / Interleaved-1F1B, ASAP tick scheduling with one-hop ppermute
+// latency, greedy buffer-slot allocation from activation lifetimes, and
+// emission of the executor tick table [T, D, 9] (column layout documented in
+// schedules.py). Semantics must match the Python implementation exactly —
+// tests assert bit-identical tables — so the Python path remains the
+// executable specification and this library is the fast production path
+// (large D*V*M schedule compilation is O(actions * ticks) host work).
+//
+// This fills the native-runtime slot that the reference occupies with
+// vendored C++ (c10d/gloo transport + ATen, SURVEY.md §2.3): here the
+// transport/compute layers are XLA's native code, and the first-party native
+// layer is this schedule engine plus the Pallas kernels.
+//
+// Build: make -C csrc   (produces libschedule_engine.so; loaded via ctypes)
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <queue>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Action {
+  int stage;
+  bool backward;
+  int mb;
+  bool operator<(const Action& o) const {
+    if (stage != o.stage) return stage < o.stage;
+    if (backward != o.backward) return backward < o.backward;
+    return mb < o.mb;
+  }
+};
+
+using Order = std::vector<Action>;
+
+int fail(char* err, int errlen, const std::string& msg) {
+  std::strncpy(err, msg.c_str(), errlen - 1);
+  err[errlen - 1] = '\0';
+  return 1;
+}
+
+std::vector<Order> gpipe_order(int D, int M) {
+  std::vector<Order> orders(D);
+  for (int d = 0; d < D; ++d) {
+    for (int m = 0; m < M; ++m) orders[d].push_back({d, false, m});
+    for (int m = 0; m < M; ++m) orders[d].push_back({d, true, m});
+  }
+  return orders;
+}
+
+std::vector<Order> one_f_one_b_order(int D, int M) {
+  std::vector<Order> orders(D);
+  for (int d = 0; d < D; ++d) {
+    int warmup = std::min(M, D - 1 - d);
+    int nf = 0, nb = 0;
+    for (; nf < warmup; ++nf) orders[d].push_back({d, false, nf});
+    while (nf < M) {
+      orders[d].push_back({d, false, nf++});
+      orders[d].push_back({d, true, nb++});
+    }
+    for (; nb < M; ++nb) orders[d].push_back({d, true, nb});
+  }
+  return orders;
+}
+
+std::vector<Order> interleaved_order(int D, int V, int M) {
+  if (V == 1) return one_f_one_b_order(D, M);
+  int num_rounds = std::max(1, M / D);
+  int mbpr = M / num_rounds;  // microbatches per round
+  int total = M * V;
+  std::vector<Order> orders(D);
+  auto fwd_vm = [&](int i, int* v, int* m) {
+    *v = (i / mbpr) % V;
+    *m = (i / (mbpr * V)) * mbpr + (i % mbpr);
+  };
+  auto bwd_vm = [&](int j, int* v, int* m) {
+    *v = V - 1 - ((j / mbpr) % V);
+    *m = (j / (mbpr * V)) * mbpr + (j % mbpr);
+  };
+  for (int d = 0; d < D; ++d) {
+    int warmup = std::min(total, (V - 1) * mbpr + 2 * (D - 1 - d));
+    int nf = 0, nb = 0, v, m;
+    for (; nf < warmup; ++nf) {
+      fwd_vm(nf, &v, &m);
+      orders[d].push_back({v * D + d, false, m});
+    }
+    while (nf < total) {
+      fwd_vm(nf++, &v, &m);
+      orders[d].push_back({v * D + d, false, m});
+      bwd_vm(nb++, &v, &m);
+      orders[d].push_back({v * D + d, true, m});
+    }
+    while (nb < total) {
+      bwd_vm(nb++, &v, &m);
+      orders[d].push_back({v * D + d, true, m});
+    }
+  }
+  return orders;
+}
+
+// Greedy interval slot allocation, identical to schedules._allocate_slots:
+// events sorted by (store, release); min-heap of freed slots so the
+// lowest-numbered free slot is always reused first.
+struct SlotAlloc {
+  std::map<std::pair<int, int>, int> assign;  // (stage, mb) -> slot
+  int n_slots = 0;
+};
+
+SlotAlloc allocate(std::vector<std::tuple<int, int, std::pair<int, int>>> events) {
+  std::sort(events.begin(), events.end(),
+            [](const auto& a, const auto& b) {
+              if (std::get<0>(a) != std::get<0>(b))
+                return std::get<0>(a) < std::get<0>(b);
+              return std::get<1>(a) < std::get<1>(b);
+            });
+  std::priority_queue<int, std::vector<int>, std::greater<int>> free_slots;
+  std::priority_queue<std::pair<int, int>, std::vector<std::pair<int, int>>,
+                      std::greater<std::pair<int, int>>> in_use;  // (release, slot)
+  SlotAlloc out;
+  for (const auto& [store, release, key] : events) {
+    while (!in_use.empty() && in_use.top().first < store) {
+      free_slots.push(in_use.top().second);
+      in_use.pop();
+    }
+    int slot;
+    if (!free_slots.empty()) {
+      slot = free_slots.top();
+      free_slots.pop();
+    } else {
+      slot = out.n_slots++;
+    }
+    out.assign[key] = slot;
+    in_use.push({release, slot});
+  }
+  return out;
+}
+
+// Tick-table column layout (schedules.py).
+enum Cols {
+  COL_STORE_F_SLOT = 0,
+  COL_FWD_V = 1, COL_FWD_M = 2, COL_FWD_SLOT = 3,
+  COL_STORE_B_SLOT = 4,
+  COL_BWD_V = 5, COL_BWD_M = 6,
+  COL_BWD_ASLOT = 7, COL_BWD_GSLOT = 8,
+  N_COLS = 9,
+};
+
+}  // namespace
+
+extern "C" {
+
+// Compiles a schedule. Returns 0 on success. table_out must hold
+// table_capacity int32s; on success *t_out ticks were written as
+// [T, D, N_COLS]. Matches compile_schedule() in schedules.py bit-for-bit.
+int dtpp_compile_schedule(const char* name, int D, int V, int M,
+                          int32_t* table_out, int64_t table_capacity,
+                          int* t_out, int* n_act_out, int* n_grad_out,
+                          char* err, int errlen) {
+  std::string sname(name);
+  std::vector<Order> orders;
+  if (sname == "GPipe") {
+    if (V != 1) return fail(err, errlen, "GPipe supports a single stage per device");
+    orders = gpipe_order(D, M);
+  } else if (sname == "1F1B" || (sname == "Interleaved1F1B" && V == 1)) {
+    if (M < D) return fail(err, errlen, "1F1B requires n_microbatches >= n_devices");
+    orders = one_f_one_b_order(D, M);
+  } else if (sname == "Interleaved1F1B") {
+    int num_rounds = std::max(1, M / D);
+    if (M % num_rounds != 0)
+      return fail(err, errlen, "Interleaved1F1B requires n_microbatches % num_rounds == 0");
+    orders = interleaved_order(D, V, M);
+  } else {
+    return fail(err, errlen, "unknown schedule: " + sname);
+  }
+
+  const int S = D * V;
+  // --- ASAP tick scheduling (schedule_ticks) ---
+  std::map<Action, int> done;
+  std::vector<size_t> ptr(D, 0);
+  int n_actions = 0;
+  for (const auto& o : orders) n_actions += o.size();
+  const int limit = 4 * n_actions + 4 * S + 16;
+  int t = 0;
+  auto pending = [&]() {
+    for (int d = 0; d < D; ++d)
+      if (ptr[d] < orders[d].size()) return true;
+    return false;
+  };
+  while (pending()) {
+    if (t > limit) return fail(err, errlen, "schedule deadlocked");
+    for (int d = 0; d < D; ++d) {
+      if (ptr[d] >= orders[d].size()) continue;
+      const Action& a = orders[d][ptr[d]];
+      bool ready;
+      if (!a.backward) {
+        if (a.stage == 0) {
+          ready = true;
+        } else {
+          auto it = done.find({a.stage - 1, false, a.mb});
+          ready = it != done.end() && it->second + 1 <= t;
+        }
+      } else {
+        ready = done.count({a.stage, false, a.mb}) > 0;
+        if (ready && a.stage != S - 1) {
+          auto it = done.find({a.stage + 1, true, a.mb});
+          ready = it != done.end() && it->second + 1 <= t;
+        }
+      }
+      if (ready) {
+        done[a] = t;
+        ++ptr[d];
+      }
+    }
+    ++t;
+  }
+  int T = t + 1;  // +1 for trailing arrivals (trimmed below)
+
+  // --- slot allocation from lifetimes ---
+  std::vector<std::vector<std::tuple<int, int, std::pair<int, int>>>>
+      act_events(D), grad_events(D);
+  for (const auto& [a, ta] : done) {
+    if (a.backward) continue;
+    int d = a.stage % D;
+    int store = a.stage == 0 ? ta : done.at({a.stage - 1, false, a.mb}) + 1;
+    int release = done.at({a.stage, true, a.mb});
+    act_events[d].push_back({store, release, {a.stage, a.mb}});
+  }
+  for (const auto& [a, ta] : done) {
+    if (!a.backward || a.stage == S - 1) continue;
+    int d = a.stage % D;
+    int store = done.at({a.stage + 1, true, a.mb}) + 1;
+    grad_events[d].push_back({store, ta, {a.stage, a.mb}});
+  }
+  std::vector<SlotAlloc> act_alloc(D), grad_alloc(D);
+  int n_act = 0, n_grad = 0;
+  for (int d = 0; d < D; ++d) {
+    act_alloc[d] = allocate(act_events[d]);
+    grad_alloc[d] = allocate(grad_events[d]);
+    n_act = std::max(n_act, act_alloc[d].n_slots);
+    n_grad = std::max(n_grad, grad_alloc[d].n_slots);
+  }
+  n_grad = std::max(n_grad, 1);
+
+  // --- table emission ---
+  if (static_cast<int64_t>(T) * D * N_COLS > table_capacity)
+    return fail(err, errlen, "table capacity too small");
+  std::vector<int32_t> table(static_cast<size_t>(T) * D * N_COLS, -1);
+  auto cell = [&](int tt, int d, int c) -> int32_t& {
+    return table[(static_cast<size_t>(tt) * D + d) * N_COLS + c];
+  };
+  for (const auto& [a, ta] : done) {
+    int d = a.stage % D;
+    int v = a.stage / D;
+    if (!a.backward) {
+      cell(ta, d, COL_FWD_V) = v;
+      cell(ta, d, COL_FWD_M) = a.mb;
+      cell(ta, d, COL_FWD_SLOT) = act_alloc[d].assign.at({a.stage, a.mb});
+      if (a.stage < S - 1) {
+        int nd = (a.stage + 1) % D;
+        cell(ta + 1, nd, COL_STORE_F_SLOT) =
+            act_alloc[nd].assign.at({a.stage + 1, a.mb});
+      }
+    } else {
+      cell(ta, d, COL_BWD_V) = v;
+      cell(ta, d, COL_BWD_M) = a.mb;
+      cell(ta, d, COL_BWD_ASLOT) = act_alloc[d].assign.at({a.stage, a.mb});
+      if (a.stage < S - 1)
+        cell(ta, d, COL_BWD_GSLOT) = grad_alloc[d].assign.at({a.stage, a.mb});
+      if (a.stage > 0) {
+        int pd = (a.stage - 1) % D;
+        cell(ta + 1, pd, COL_STORE_B_SLOT) =
+            grad_alloc[pd].assign.at({a.stage - 1, a.mb});
+      }
+    }
+  }
+  // trim trailing all-empty ticks
+  auto tick_empty = [&](int tt) {
+    for (int d = 0; d < D; ++d)
+      for (int c = 0; c < N_COLS; ++c)
+        if (cell(tt, d, c) != -1) return false;
+    return true;
+  };
+  while (T > 1 && tick_empty(T - 1)) --T;
+
+  std::memcpy(table_out, table.data(),
+              static_cast<size_t>(T) * D * N_COLS * sizeof(int32_t));
+  *t_out = T;
+  *n_act_out = n_act;
+  *n_grad_out = n_grad;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,87 @@
+"""ctypes binding for the native (C++) schedule-compilation engine.
+
+``compile_schedule_native`` produces the same ``CompiledSchedule`` as the
+Python compiler in :mod:`.schedules` (tables are asserted bit-identical in
+tests); the Python path is the executable specification, this is the fast
+production path. The shared library is built on first use with the repo's
+``csrc/Makefile`` (plain g++, no external deps); if no compiler is available
+the caller should fall back to the Python compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_LIB_PATH = os.path.abspath(os.path.join(_CSRC, "libschedule_engine.so"))
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB_PATH):
+                subprocess.run(["make", "-C", os.path.abspath(_CSRC)],
+                               check=True, capture_output=True)
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.dtpp_compile_schedule.restype = ctypes.c_int
+            lib.dtpp_compile_schedule.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_int,
+            ]
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def compile_schedule_native(name: str, n_devices: int, n_virtual: int,
+                            n_microbatches: int):
+    """Native twin of ``schedules.compile_schedule`` (without the Action tick
+    map — the table is the executor contract). Raises ScheduleError with the
+    engine's message on invalid configs, RuntimeError if the library is
+    unavailable."""
+    from .schedules import CompiledSchedule, ScheduleError, verify_table
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native schedule engine unavailable (no compiler?)")
+    S = n_devices * n_virtual
+    n_actions = 2 * S * n_microbatches
+    cap_ticks = 4 * n_actions + 4 * S + 18
+    table = np.full((cap_ticks, n_devices, 9), -1, dtype=np.int32)
+    t_out = ctypes.c_int()
+    n_act = ctypes.c_int()
+    n_grad = ctypes.c_int()
+    err = ctypes.create_string_buffer(256)
+    rc = lib.dtpp_compile_schedule(
+        name.encode(), n_devices, n_virtual, n_microbatches,
+        table.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), table.size,
+        ctypes.byref(t_out), ctypes.byref(n_act), ctypes.byref(n_grad),
+        err, len(err))
+    if rc != 0:
+        raise ScheduleError(err.value.decode())
+    cs = CompiledSchedule(
+        name=name, n_devices=n_devices, n_virtual=n_virtual,
+        n_microbatches=n_microbatches, table=table[: t_out.value].copy(),
+        makespan=t_out.value, ticks={}, n_act_slots=n_act.value,
+        n_grad_slots=n_grad.value)
+    verify_table(cs)
+    return cs

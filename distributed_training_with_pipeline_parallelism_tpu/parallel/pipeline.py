@@ -65,6 +65,21 @@ def _shard_map(fn, mesh, in_specs, out_specs):
 Pytree = Any
 
 
+def _compile(name: str, D: int, V: int, M: int) -> CompiledSchedule:
+    """Compile via the native C++ engine when available (bit-identical to the
+    Python compiler — see tests/test_native_engine.py), else in Python."""
+    from . import native
+    if native.native_available():
+        from .schedules import ScheduleError
+        try:
+            return native.compile_schedule_native(name, D, V, M)
+        except ScheduleError:
+            raise
+        except Exception:
+            pass  # fall through to the Python reference implementation
+    return compile_schedule(name, D, V, M)
+
+
 # ---------------------------------------------------------------------------
 # Stage slicing: full-model pytree <-> stacked per-device layout
 # ---------------------------------------------------------------------------
@@ -118,7 +133,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     n_data = mesh.shape.get(DATA_AXIS, 1)
     V = sched.n_virtual
     M = sched.n_microbatches
-    cs: CompiledSchedule = compile_schedule(sched.name, D, V, M)
+    cs: CompiledSchedule = _compile(sched.name, D, V, M)
     table = jnp.asarray(cs.table)  # [T, D, 8]
     dtype = jnp.dtype(cfg.dtype)
     fwd_perm = [(i, (i + 1) % D) for i in range(D)]

@@ -1,0 +1,33 @@
+"""Native (C++) schedule engine: bit-identical to the Python compiler."""
+
+import numpy as np
+import pytest
+
+from distributed_training_with_pipeline_parallelism_tpu.parallel import native
+from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules import (
+    ScheduleError, compile_schedule)
+
+pytestmark = pytest.mark.skipif(not native.native_available(),
+                                reason="no C++ toolchain")
+
+
+@pytest.mark.parametrize("name,D,V,M", [
+    ("GPipe", 2, 1, 4), ("GPipe", 8, 1, 8),
+    ("1F1B", 4, 1, 4), ("1F1B", 4, 1, 16), ("1F1B", 8, 1, 8),
+    ("Interleaved1F1B", 2, 2, 4), ("Interleaved1F1B", 4, 2, 8),
+    ("Interleaved1F1B", 2, 4, 8), ("Interleaved1F1B", 4, 1, 4),
+])
+def test_native_matches_python(name, D, V, M):
+    py = compile_schedule(name, D, V, M)
+    nat = native.compile_schedule_native(name, D, V, M)
+    assert nat.makespan == py.makespan
+    assert nat.n_act_slots == py.n_act_slots
+    assert nat.n_grad_slots == py.n_grad_slots
+    np.testing.assert_array_equal(nat.table, py.table)
+
+
+def test_native_error_contract():
+    with pytest.raises(ScheduleError):
+        native.compile_schedule_native("1F1B", 8, 1, 2)  # M < D
+    with pytest.raises(ScheduleError):
+        native.compile_schedule_native("NoSuch", 2, 1, 4)
