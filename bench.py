@@ -31,10 +31,12 @@ BASELINE_TOKS_PER_SEC = 1671.32  # GPipe L8/H8 2 procs, reference cell 25
 
 
 def run(batch_size: int = 32, seq_length: int = 128, num_iterations: int = 20,
-        schedule: str = "GPipe", n_microbatches: int = 4) -> dict:
+        schedule: str = "GPipe", n_microbatches: int = 4,
+        dtype: str = "bfloat16") -> dict:
     n_devices = len(jax.devices())
     n_pipe = n_devices  # 1-D pipeline mesh over every visible chip
-    cfg = dtpp.ModelConfig()  # reference defaults: dim 768, L8, H8, vocab 10k
+    # reference defaults (dim 768, L8, H8, vocab 10k) in the MXU-native dtype
+    cfg = dtpp.ModelConfig(dtype=dtype)
     sched = dtpp.ScheduleConfig(name=schedule, n_microbatches=n_microbatches)
     mesh = make_mesh(n_pipe=n_pipe)
     step = make_pipeline_step(cfg, mesh, sched)
@@ -58,7 +60,7 @@ def run(batch_size: int = 32, seq_length: int = 128, num_iterations: int = 20,
     throughput = tokens_processed / elapsed
     return {
         "metric": f"pipeline train-step throughput ({schedule}, L8/H8, "
-                  f"batch {batch_size}, seq {seq_length}, {n_pipe}-stage)",
+                  f"batch {batch_size}, seq {seq_length}, {n_pipe}-stage, {dtype})",
         "value": round(throughput, 2),
         "unit": "tokens/sec",
         "vs_baseline": round(throughput / BASELINE_TOKS_PER_SEC, 3),

@@ -140,6 +140,10 @@ def body_apply(cfg: ModelConfig, layers: Dict, h: jax.Array) -> jax.Array:
     def step(carry, layer_params):
         return layer_apply(cfg, layer_params, carry, rope), None
 
+    if cfg.remat_layers:
+        # rematerialize each layer in backward: activation memory drops from
+        # O(layers x intermediates) to O(layers) block inputs
+        step = jax.checkpoint(step)
     out, _ = jax.lax.scan(step, h, layers)
     return out
 

@@ -46,6 +46,24 @@ def make_mesh(n_pipe: int, n_data: int = 1,
     return Mesh(grid, (DATA_AXIS, PIPE_AXIS))
 
 
+def init_multihost(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> None:
+    """Initialize JAX's multi-host runtime for pod slices.
+
+    TPU-native replacement for the reference's env-var rendezvous +
+    ``init_process_group`` (``LLMsDistributedTrainingHelper.py:168-175``): on
+    Cloud TPU the arguments auto-detect from the metadata server; elsewhere
+    pass coordinator ``host:port``, world size, and this process's rank.
+    After this, ``jax.devices()`` spans the slice and meshes built by
+    :func:`make_mesh` place inter-host edges on DCN transparently (XLA
+    routes collectives ICI-first).
+    """
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
 def simulate_cpu_devices(n: int = 8) -> None:
     """Request n simulated CPU devices. Must run before the first jax import
     in the process; prefer setting the env vars at interpreter start (see

@@ -1,0 +1,106 @@
+"""Tensor (intra-layer) parallelism via GSPMD sharding annotations.
+
+SURVEY.md §2.4 marks TP as the natural extension beyond the reference's
+scope; here it is the scaling-book recipe verbatim: pick a mesh, annotate
+parameter shardings (attention heads and FFN hidden dim split over a
+'model' axis — the Megatron column/row-parallel pattern), and let XLA's
+GSPMD partitioner insert the all-reduces. No manual collectives at all —
+contrast with the pipeline executor, which is manual SPMD because schedules
+need explicit control.
+
+Composes with data parallelism (add a 'data' axis and shard the batch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.transformer import transformer_loss
+from ..utils.config import ModelConfig
+from .mesh import DATA_AXIS
+
+TP_AXIS = "model"
+
+Pytree = Any
+
+
+def make_tp_mesh(n_model: int, n_data: int = 1, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    need = n_model * n_data
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(n_data, n_model)
+    return Mesh(grid, (DATA_AXIS, TP_AXIS))
+
+
+def _layer_specs(cfg: ModelConfig) -> dict:
+    """PartitionSpecs for one arch's stacked layer leaves (leading axis =
+    layer). Column-parallel: QKV and FFN-in split on the output feature dim;
+    row-parallel: attention-out and FFN-out split on the input feature dim,
+    whose partial sums GSPMD all-reduces."""
+    col = {"w": P(None, None, TP_AXIS), "b": P(None, TP_AXIS)}
+    row = {"w": P(None, TP_AXIS, None), "b": P(None)}
+    col_nb = {"w": P(None, None, TP_AXIS)}
+    row_nb = {"w": P(None, TP_AXIS, None)}
+    ln = {"scale": P(None), "bias": P(None)}
+    rms = {"scale": P(None)}
+    attn = {"q": col, "k": col, "v": col, "o": row}
+    attn_nb = {"q": col_nb, "k": col_nb, "v": col_nb, "o": row_nb}
+    if cfg.arch == "ref_decoder":
+        return {"self_attn": attn, "cross_attn": attn, "ln1": ln, "ln2": ln,
+                "ln3": ln, "lin1": col, "lin2": row}
+    if cfg.arch == "gpt2":
+        return {"ln1": ln, "attn": attn, "ln2": ln, "lin1": col, "lin2": row}
+    if cfg.arch == "llama":
+        return {"rms1": rms, "attn": attn_nb, "rms2": rms,
+                "w1": col_nb, "w2": row_nb, "w3": col_nb}
+    raise ValueError(cfg.arch)
+
+
+def param_specs(cfg: ModelConfig) -> dict:
+    """PartitionSpec pytree for the full model: embeddings replicated, layer
+    matmuls Megatron-sharded, output head column-parallel over the vocab."""
+    embed = {"tok": P(None, None)}
+    if cfg.arch == "gpt2":
+        embed["pos"] = P(None, None)
+    head_out = ({"w": P(None, TP_AXIS), "b": P(TP_AXIS)}
+                if cfg.arch == "ref_decoder" else {"w": P(None, TP_AXIS)})
+    norm = {"scale": P(None)} if cfg.arch == "llama" else \
+        {"scale": P(None), "bias": P(None)}
+    return {"embed": embed, "layers": _layer_specs(cfg),
+            "head": {"norm": norm, "out": head_out}}
+
+
+def shard_params(params: Pytree, cfg: ModelConfig, mesh: Mesh) -> Pytree:
+    """Place a host pytree onto the mesh with TP shardings."""
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def make_tp_grad_fn(cfg: ModelConfig, mesh: Mesh,
+                    ) -> Callable[[Pytree, jax.Array, jax.Array],
+                                  Tuple[jax.Array, Pytree]]:
+    """Jitted TP (loss, grads): the model function is the plain single-device
+    ``transformer_loss``; parallelism comes entirely from input shardings +
+    GSPMD propagation. Batch is sharded over 'data' when that axis exists."""
+    specs = param_specs(cfg)
+    n_data = mesh.shape.get(DATA_AXIS, 1)
+    data_spec = P(DATA_AXIS) if n_data > 1 else P()
+    in_sh = (
+        jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                     is_leaf=lambda x: isinstance(x, P)),
+        NamedSharding(mesh, data_spec),
+        NamedSharding(mesh, data_spec),
+    )
+
+    def vg(params, tokens, targets):
+        return jax.value_and_grad(
+            lambda p: transformer_loss(cfg, p, tokens, targets))(params)
+
+    return jax.jit(vg, in_shardings=in_sh)
