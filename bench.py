@@ -50,11 +50,15 @@ def run(batch_size: int = 32, seq_length: int = 128, num_iterations: int = 20,
     for _ in range(2):  # warmup, untimed (reference :113-118)
         jax.block_until_ready(step(params, tokens, targets))
 
-    start = time.perf_counter()
-    for _ in range(num_iterations):
-        loss, grads = step(params, tokens, targets)
-    jax.block_until_ready((loss, grads))
-    elapsed = time.perf_counter() - start
+    # median of 3 measurement windows (the device tunnel is jittery)
+    elapsed_runs = []
+    for _ in range(3):
+        start = time.perf_counter()
+        for _ in range(num_iterations):
+            loss, grads = step(params, tokens, targets)
+        jax.block_until_ready((loss, grads))
+        elapsed_runs.append(time.perf_counter() - start)
+    elapsed = sorted(elapsed_runs)[1]
 
     tokens_processed = batch_size * seq_length * num_iterations
     throughput = tokens_processed / elapsed
