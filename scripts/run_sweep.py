@@ -25,6 +25,10 @@ def main():
                     help="small model / fewer configs for a smoke run")
     ap.add_argument("--out", default="results")
     ap.add_argument("--iterations", type=int, default=5)
+    ap.add_argument("--dim", type=int, default=768,
+                    help="model width (reference uses 768; smaller widths "
+                         "keep full sweeps tractable on simulated CPU meshes)")
+    ap.add_argument("--dtype", default="float32")
     args = ap.parse_args()
 
     if args.simulate_devices:
@@ -39,7 +43,7 @@ def main():
     from distributed_training_with_pipeline_parallelism_tpu.utils.sweep import (
         compute_speedup_and_efficiency, pivot_throughput, run_all_experiments)
 
-    kwargs = {}
+    kwargs = dict(dim=args.dim, dtype=args.dtype)
     if args.quick:
         kwargs = dict(layers=(4,), heads=(4, 8), devices=(2,),
                       batch_size=8, seq_length=32, dim=64, vocab_size=256)

@@ -1,0 +1,104 @@
+"""Train a model-ladder config under a pipeline schedule.
+
+Examples:
+    # tiny smoke run on 4 simulated devices
+    python scripts/train.py --model gpt2-small --layers 8 --pipe 4 \
+        --schedule 1F1B --microbatches 8 --steps 20 --simulate-devices 4 \
+        --dim 128 --heads 4 --seq 64 --batch 16
+
+    # Llama-debug, interleaved, with checkpointing
+    python scripts/train.py --model llama-debug --pipe 2 --virtual 2 \
+        --schedule Interleaved1F1B --steps 100 --ckpt /tmp/ckpt
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gpt2-small",
+                    help="gpt2-{small,medium,large,xl}, llama2-7b, llama3-8b, "
+                         "llama-debug, or ref (the reference parity model)")
+    ap.add_argument("--schedule", default="1F1B",
+                    choices=["GPipe", "1F1B", "Interleaved1F1B"])
+    ap.add_argument("--pipe", type=int, default=2)
+    ap.add_argument("--data", type=int, default=1)
+    ap.add_argument("--virtual", type=int, default=1)
+    ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--ckpt", default="", help="checkpoint dir (save at end)")
+    ap.add_argument("--resume", default="", help="checkpoint dir to load")
+    ap.add_argument("--simulate-devices", type=int, default=0)
+    # overrides to scale models down for smoke runs
+    ap.add_argument("--dim", type=int, default=0)
+    ap.add_argument("--layers", type=int, default=0)
+    ap.add_argument("--heads", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.simulate_devices:
+        os.environ["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={args.simulate_devices} "
+            + os.environ.get("XLA_FLAGS", ""))
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    import distributed_training_with_pipeline_parallelism_tpu as dtpp
+    from distributed_training_with_pipeline_parallelism_tpu.models import transformer as tfm
+    from distributed_training_with_pipeline_parallelism_tpu.models.gpt2 import gpt2_config
+    from distributed_training_with_pipeline_parallelism_tpu.models.llama import llama_config
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import make_mesh
+    from distributed_training_with_pipeline_parallelism_tpu.utils import train
+    from distributed_training_with_pipeline_parallelism_tpu.utils.checkpoint import (
+        restore_checkpoint, save_checkpoint)
+
+    overrides = {k: v for k, v in dict(
+        dim=args.dim, n_layers=args.layers, n_heads=args.heads,
+    ).items() if v}
+    overrides["dtype"] = args.dtype
+    if args.model.startswith("gpt2-"):
+        cfg = gpt2_config(args.model.removeprefix("gpt2-"), **overrides)
+    elif args.model.startswith("llama"):
+        cfg = llama_config(args.model, **overrides)
+    elif args.model == "ref":
+        cfg = dtpp.ModelConfig(**overrides)
+    else:
+        raise SystemExit(f"unknown model {args.model}")
+
+    mesh = make_mesh(n_pipe=args.pipe, n_data=args.data)
+    sched = dtpp.ScheduleConfig(name=args.schedule,
+                                n_microbatches=args.microbatches,
+                                n_virtual=args.virtual)
+    print(f"model={args.model} {cfg.dim}d x {cfg.n_layers}L x {cfg.n_heads}H, "
+          f"mesh=(data={args.data}, pipe={args.pipe}), {args.schedule} "
+          f"M={args.microbatches} V={args.virtual}", flush=True)
+
+    if args.resume:
+        template = jax.eval_shape(lambda: tfm.transformer_init(
+            jax.random.key(args.seed), cfg))
+        params = restore_checkpoint(args.resume, template=template)
+        print(f"resumed from {args.resume}", flush=True)
+    else:
+        params = tfm.transformer_init(jax.random.key(args.seed), cfg)
+
+    data = train.synthetic_data(cfg, args.batch, args.seq, seed=args.seed)
+    optimizer = train.adamw(learning_rate=args.lr, total_steps=args.steps)
+    params, history = train.fit(cfg, mesh, sched, params, data, args.steps,
+                                optimizer=optimizer, log_every=max(1, args.steps // 20))
+    if args.ckpt:
+        save_checkpoint(args.ckpt, params)
+        print(f"saved checkpoint to {args.ckpt}", flush=True)
+    print(f"final loss: {history[-1][1]:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

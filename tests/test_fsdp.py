@@ -1,0 +1,44 @@
+"""FSDP/ZeRO sharding: correctness vs unsharded, shards actually sharded."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.models import transformer as tfm
+from distributed_training_with_pipeline_parallelism_tpu.parallel import fsdp
+
+
+def test_fsdp_matches_single_device():
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=32, arch="gpt2")
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (8, 16), 0, cfg.vocab_size)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(cfg, p, tokens, targets))(params)
+
+    mesh = fsdp.make_fsdp_mesh(4)
+    sharded = fsdp.shard_params_fsdp(params, mesh)
+    loss, grads = fsdp.make_fsdp_grad_fn(cfg, mesh, params)(sharded, tokens, targets)
+    assert float(jnp.abs(loss - ref_loss)) < 1e-5
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                       grads, ref_grads)
+    assert max(jax.tree.leaves(err)) < 2e-5
+
+
+def test_fsdp_memory_actually_sharded():
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    mesh = fsdp.make_fsdp_mesh(4)
+    sharded = fsdp.shard_params_fsdp(params, mesh)
+    # embedding [64, 32]: sharded over vocab -> each device holds 1/4
+    shard_shapes = {s.data.shape for s in sharded["embed"]["tok"].addressable_shards}
+    assert shard_shapes == {(16, 32)}
+    # grads come back sharded too (ZeRO reduce-scatter)
+    tokens = jnp.zeros((4, 8), jnp.int32)
+    _, grads = fsdp.make_fsdp_grad_fn(cfg, mesh, params)(sharded, tokens, tokens)
+    gshard = {s.data.shape for s in grads["embed"]["tok"].addressable_shards}
+    assert gshard == {(16, 32)}
