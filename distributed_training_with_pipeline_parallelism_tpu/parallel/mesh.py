@@ -65,12 +65,29 @@ def init_multihost(coordinator_address: Optional[str] = None,
 
 
 def simulate_cpu_devices(n: int = 8) -> None:
-    """Request n simulated CPU devices. Must run before the first jax import
-    in the process; prefer setting the env vars at interpreter start (see
-    tests/conftest.py)."""
+    """Force an n-device simulated CPU backend (the JAX analog of the
+    reference's gloo-on-localhost fake cluster, SURVEY.md §4).
+
+    Must run before the first backend initialization in the process. Two
+    traps this helper handles centrally (callers should not hand-roll it):
+
+    - Duplicate ``--xla_force_host_platform_device_count`` flags: the *last*
+      occurrence wins, so the requested count is appended — a pre-existing
+      count in ``XLA_FLAGS`` (e.g. from the shell) is overridden, not
+      silently kept.
+    - Platform plugins (e.g. the axon TPU tunnel) auto-select themselves even
+      when ``JAX_PLATFORMS=cpu`` is in the environment; only
+      ``jax.config.update("jax_platforms", "cpu")`` reliably wins.
+    """
     import os
 
     os.environ["XLA_FLAGS"] = (
-        f"--xla_force_host_platform_device_count={n} "
-        + os.environ.get("XLA_FLAGS", ""))
-    os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n}")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax as _jax  # local import: this may be the process's first
+
+    try:
+        _jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized; caller gets whatever exists

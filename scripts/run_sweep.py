@@ -25,28 +25,28 @@ def main():
                     help="small model / fewer configs for a smoke run")
     ap.add_argument("--out", default="results")
     ap.add_argument("--iterations", type=int, default=5)
-    ap.add_argument("--dim", type=int, default=768,
-                    help="model width (reference uses 768; smaller widths "
-                         "keep full sweeps tractable on simulated CPU meshes)")
+    ap.add_argument("--dim", type=int, default=None,
+                    help="model width (default: reference's 768, or 64 under "
+                         "--quick; smaller widths keep full sweeps tractable "
+                         "on simulated CPU meshes)")
     ap.add_argument("--dtype", default="float32")
     args = ap.parse_args()
 
     if args.simulate_devices:
-        os.environ["XLA_FLAGS"] = (
-            f"--xla_force_host_platform_device_count={args.simulate_devices} "
-            + os.environ.get("XLA_FLAGS", ""))
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+            simulate_cpu_devices)
+        simulate_cpu_devices(args.simulate_devices)
 
     from distributed_training_with_pipeline_parallelism_tpu.utils.plotting import (
         plot_speedup_and_efficiency, plot_throughput_grid)
     from distributed_training_with_pipeline_parallelism_tpu.utils.sweep import (
         compute_speedup_and_efficiency, pivot_throughput, run_all_experiments)
 
-    kwargs = dict(dim=args.dim, dtype=args.dtype)
+    kwargs = dict(dim=args.dim or 768, dtype=args.dtype)
     if args.quick:
-        kwargs = dict(layers=(4,), heads=(4, 8), devices=(2,),
-                      batch_size=8, seq_length=32, dim=64, vocab_size=256)
+        kwargs.update(layers=(4,), heads=(4, 8), devices=(2,),
+                      batch_size=8, seq_length=32, dim=args.dim or 64,
+                      vocab_size=256)
     df = run_all_experiments(num_iterations=args.iterations, **kwargs)
 
     os.makedirs(args.out, exist_ok=True)

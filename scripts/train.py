@@ -38,18 +38,19 @@ def main():
     ap.add_argument("--resume", default="", help="checkpoint dir to load")
     ap.add_argument("--simulate-devices", type=int, default=0)
     # overrides to scale models down for smoke runs
-    ap.add_argument("--dim", type=int, default=0)
+    ap.add_argument("--dim", type=int, default=0,
+                    help="override model width; ffn_dim rescales "
+                         "proportionally unless --ffn is also given")
+    ap.add_argument("--ffn", type=int, default=0)
     ap.add_argument("--layers", type=int, default=0)
     ap.add_argument("--heads", type=int, default=0)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
     if args.simulate_devices:
-        os.environ["XLA_FLAGS"] = (
-            f"--xla_force_host_platform_device_count={args.simulate_devices} "
-            + os.environ.get("XLA_FLAGS", ""))
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+            simulate_cpu_devices)
+        simulate_cpu_devices(args.simulate_devices)
     import jax
 
     import distributed_training_with_pipeline_parallelism_tpu as dtpp
@@ -61,18 +62,25 @@ def main():
     from distributed_training_with_pipeline_parallelism_tpu.utils.checkpoint import (
         restore_checkpoint, save_checkpoint)
 
+    def build_cfg(**overrides):
+        if args.model.startswith("gpt2-"):
+            return gpt2_config(args.model.removeprefix("gpt2-"), **overrides)
+        if args.model.startswith("llama"):
+            return llama_config(args.model, **overrides)
+        if args.model == "ref":
+            return dtpp.ModelConfig(**overrides)
+        raise SystemExit(f"unknown model {args.model}")
+
     overrides = {k: v for k, v in dict(
-        dim=args.dim, n_layers=args.layers, n_heads=args.heads,
+        dim=args.dim, ffn_dim=args.ffn, n_layers=args.layers,
+        n_heads=args.heads,
     ).items() if v}
     overrides["dtype"] = args.dtype
-    if args.model.startswith("gpt2-"):
-        cfg = gpt2_config(args.model.removeprefix("gpt2-"), **overrides)
-    elif args.model.startswith("llama"):
-        cfg = llama_config(args.model, **overrides)
-    elif args.model == "ref":
-        cfg = dtpp.ModelConfig(**overrides)
-    else:
-        raise SystemExit(f"unknown model {args.model}")
+    if args.dim and not args.ffn:
+        # keep the family's FFN:dim ratio when scaling width down/up
+        base = build_cfg()
+        overrides["ffn_dim"] = max(1, round(base.ffn_dim * args.dim / base.dim))
+    cfg = build_cfg(**overrides)
 
     mesh = make_mesh(n_pipe=args.pipe, n_data=args.data)
     sched = dtpp.ScheduleConfig(name=args.schedule,
@@ -97,7 +105,8 @@ def main():
     if args.ckpt:
         save_checkpoint(args.ckpt, params)
         print(f"saved checkpoint to {args.ckpt}", flush=True)
-    print(f"final loss: {history[-1][1]:.4f}", flush=True)
+    if history:
+        print(f"final loss: {history[-1][1]:.4f}", flush=True)
 
 
 if __name__ == "__main__":
