@@ -1,0 +1,84 @@
+"""Expert parallelism: MoE experts sharded over an 'expert' mesh axis.
+
+Beyond-parity capability (SURVEY.md §2.4: the reference has no MoE/EP).
+The sharding recipe is the GShard one (arXiv:2006.16668), expressed as
+SPMD over a 1-D ``Mesh(('expert',))``:
+
+- The token batch and the expert stacks (leading dim of w1/b1/w2/b2) are
+  both sharded over 'expert'; the router and all attention/norm/embed
+  parameters are replicated.
+- Each device routes its local tokens, packs per-expert capacity slots,
+  and exchanges slot blocks with every other device via
+  ``jax.lax.all_to_all`` — the ICI-native equivalent of NCCL all-to-all
+  dispatch in GPU MoE stacks — runs its resident experts, and reverses
+  the exchange. Static slot shapes mean both collectives compile to fixed
+  ICI transfers with no data-dependent sizes.
+- Gradients need no extra code: ``all_to_all`` is its own transpose, so
+  ``jax.grad`` of the shard_mapped loss produces the reverse exchanges.
+
+Composes with data parallelism by treating 'expert' as the data axis for
+the non-MoE parameters (they see a plain batch shard).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.tree_util import DictKey
+
+from ..models.moe import MoEConfig, moe_lm_loss
+from ..utils.config import ModelConfig
+from .mesh import EXPERT_AXIS
+from .pipeline import _shard_map
+
+Pytree = Any
+
+_EXPERT_LEAVES = frozenset({"w1", "b1", "w2", "b2"})
+
+
+def ep_param_specs(params: Pytree) -> Pytree:
+    """PartitionSpec tree for a MoE LM pytree: expert stacks are sharded on
+    their expert dim (axis 1 — axis 0 is the layer stack), everything else
+    replicated."""
+
+    def spec(path, _leaf):
+        keys = [k.key for k in path if isinstance(k, DictKey)]
+        if "moe" in keys and keys[-1] in _EXPERT_LEAVES:
+            return P(None, EXPERT_AXIS)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def make_ep_loss_fn(cfg: ModelConfig, moe: MoEConfig, mesh: Mesh,
+                    ) -> Callable[[Pytree, jax.Array, jax.Array], jax.Array]:
+    """Expert-parallel ``(params, tokens, targets) -> scalar loss``.
+
+    ``params`` is the full (unsharded-layout) pytree from ``moe_lm_init``;
+    shard_map's in_specs slice the expert stacks across the mesh. Tokens /
+    targets are [B, S] with B divisible by the mesh size. Differentiable —
+    wrap in ``jax.value_and_grad`` (+jit) for training. The CE term matches
+    the unsharded :func:`..models.moe.moe_lm_loss` exactly when no tokens
+    overflow expert capacity; the aux load-balancing term uses *per-shard*
+    routing statistics (standard local load balancing), so full-loss
+    equality additionally requires ``aux_loss_weight=0`` — see
+    tests/test_moe.py."""
+    if moe.n_experts % mesh.shape[EXPERT_AXIS] != 0:
+        raise ValueError(
+            f"n_experts={moe.n_experts} must divide over "
+            f"{mesh.shape[EXPERT_AXIS]} expert shards")
+
+    def spmd_loss(params, tokens, targets):
+        return moe_lm_loss(cfg, moe, params, tokens, targets,
+                           axis_name=EXPERT_AXIS)
+
+    def loss_fn(params, tokens, targets):
+        return _shard_map(
+            spmd_loss, mesh,
+            in_specs=(ep_param_specs(params), P(EXPERT_AXIS), P(EXPERT_AXIS)),
+            out_specs=P(),
+        )(params, tokens, targets)
+
+    return loss_fn

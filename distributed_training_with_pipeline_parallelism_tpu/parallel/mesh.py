@@ -20,6 +20,7 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
 
 
 def make_sp_mesh(n_seq: int, devices=None) -> "Mesh":
@@ -28,6 +29,14 @@ def make_sp_mesh(n_seq: int, devices=None) -> "Mesh":
     if len(devices) < n_seq:
         raise ValueError(f"need {n_seq} devices, have {len(devices)}")
     return Mesh(np.asarray(devices[:n_seq]), (SEQ_AXIS,))
+
+
+def make_ep_mesh(n_expert: int, devices=None) -> "Mesh":
+    """1-D expert-parallel mesh for MoE all-to-all dispatch."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n_expert:
+        raise ValueError(f"need {n_expert} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n_expert]), (EXPERT_AXIS,))
 
 
 def make_mesh(n_pipe: int, n_data: int = 1,
@@ -89,5 +98,5 @@ def simulate_cpu_devices(n: int = 8) -> None:
 
     try:
         _jax.config.update("jax_platforms", "cpu")
-    except Exception:
+    except RuntimeError:
         pass  # backend already initialized; caller gets whatever exists

@@ -42,11 +42,11 @@ def main():
     from distributed_training_with_pipeline_parallelism_tpu.utils.sweep import (
         compute_speedup_and_efficiency, pivot_throughput, run_all_experiments)
 
-    kwargs = dict(dim=args.dim or 768, dtype=args.dtype)
+    dim = args.dim or (64 if args.quick else 768)
+    kwargs = dict(dim=dim, dtype=args.dtype)
     if args.quick:
         kwargs.update(layers=(4,), heads=(4, 8), devices=(2,),
-                      batch_size=8, seq_length=32, dim=args.dim or 64,
-                      vocab_size=256)
+                      batch_size=8, seq_length=32, vocab_size=256)
     df = run_all_experiments(num_iterations=args.iterations, **kwargs)
 
     os.makedirs(args.out, exist_ok=True)
