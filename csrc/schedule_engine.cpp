@@ -1,12 +1,12 @@
 // Native schedule-compilation engine.
 //
 // C++ twin of parallel/schedules.py: per-device action-order generation for
-// GPipe / 1F1B / Interleaved-1F1B, ASAP tick scheduling with one-hop ppermute
-// latency, greedy buffer-slot allocation from activation lifetimes, and
-// emission of the executor tick table [T, D, 9] (column layout documented in
-// schedules.py). Semantics must match the Python implementation exactly —
-// tests assert bit-identical tables — so the Python path remains the
-// executable specification and this library is the fast production path
+// GPipe / 1F1B / Interleaved-1F1B / ZB-H1, ASAP tick scheduling with one-hop
+// ppermute latency, greedy buffer-slot allocation from activation lifetimes,
+// and emission of the executor tick table [T, D, 13] (column layout
+// documented in schedules.py). Semantics must match the Python implementation
+// exactly — tests assert bit-identical tables — so the Python path remains
+// the executable specification and this library is the fast production path
 // (large D*V*M schedule compilation is O(actions * ticks) host work).
 //
 // This fills the native-runtime slot that the reference occupies with
@@ -26,13 +26,15 @@
 
 namespace {
 
+enum Op { OP_F = 0, OP_B = 1, OP_W = 2 };
+
 struct Action {
   int stage;
-  bool backward;
+  int op;  // Op
   int mb;
   bool operator<(const Action& o) const {
     if (stage != o.stage) return stage < o.stage;
-    if (backward != o.backward) return backward < o.backward;
+    if (op != o.op) return op < o.op;
     return mb < o.mb;
   }
 };
@@ -48,8 +50,8 @@ int fail(char* err, int errlen, const std::string& msg) {
 std::vector<Order> gpipe_order(int D, int M) {
   std::vector<Order> orders(D);
   for (int d = 0; d < D; ++d) {
-    for (int m = 0; m < M; ++m) orders[d].push_back({d, false, m});
-    for (int m = 0; m < M; ++m) orders[d].push_back({d, true, m});
+    for (int m = 0; m < M; ++m) orders[d].push_back({d, OP_F, m});
+    for (int m = 0; m < M; ++m) orders[d].push_back({d, OP_B, m});
   }
   return orders;
 }
@@ -59,12 +61,12 @@ std::vector<Order> one_f_one_b_order(int D, int M) {
   for (int d = 0; d < D; ++d) {
     int warmup = std::min(M, D - 1 - d);
     int nf = 0, nb = 0;
-    for (; nf < warmup; ++nf) orders[d].push_back({d, false, nf});
+    for (; nf < warmup; ++nf) orders[d].push_back({d, OP_F, nf});
     while (nf < M) {
-      orders[d].push_back({d, false, nf++});
-      orders[d].push_back({d, true, nb++});
+      orders[d].push_back({d, OP_F, nf++});
+      orders[d].push_back({d, OP_B, nb++});
     }
-    for (; nb < M; ++nb) orders[d].push_back({d, true, nb});
+    for (; nb < M; ++nb) orders[d].push_back({d, OP_B, nb});
   }
   return orders;
 }
@@ -88,17 +90,48 @@ std::vector<Order> interleaved_order(int D, int V, int M) {
     int nf = 0, nb = 0, v, m;
     for (; nf < warmup; ++nf) {
       fwd_vm(nf, &v, &m);
-      orders[d].push_back({v * D + d, false, m});
+      orders[d].push_back({v * D + d, OP_F, m});
     }
     while (nf < total) {
       fwd_vm(nf++, &v, &m);
-      orders[d].push_back({v * D + d, false, m});
+      orders[d].push_back({v * D + d, OP_F, m});
       bwd_vm(nb++, &v, &m);
-      orders[d].push_back({v * D + d, true, m});
+      orders[d].push_back({v * D + d, OP_B, m});
     }
     while (nb < total) {
       bwd_vm(nb++, &v, &m);
-      orders[d].push_back({v * D + d, true, m});
+      orders[d].push_back({v * D + d, OP_B, m});
+    }
+  }
+  return orders;
+}
+
+// ZB-H1 (arXiv:2401.10241): dgrad/wgrad split backward; stage 0 has no B
+// (nothing upstream to send a cotangent to) — its W does the full
+// parameter+embedding backward. Mirrors schedules.zb_h1_order.
+std::vector<Order> zb_h1_order(int D, int M) {
+  std::vector<Order> orders(D);
+  for (int d = 0; d < D; ++d) {
+    int warmup = std::min(M, D - d);
+    int nf = 0, nb = 0;
+    for (; nf < warmup; ++nf) orders[d].push_back({d, OP_F, nf});
+    if (d == 0) {
+      while (nf < M) {
+        orders[d].push_back({0, OP_W, nb++});
+        orders[d].push_back({0, OP_F, nf++});
+      }
+      for (; nb < M; ++nb) orders[d].push_back({0, OP_W, nb});
+    } else {
+      while (nf < M) {
+        orders[d].push_back({d, OP_B, nb});
+        orders[d].push_back({d, OP_W, nb});
+        ++nb;
+        orders[d].push_back({d, OP_F, nf++});
+      }
+      for (; nb < M; ++nb) {
+        orders[d].push_back({d, OP_B, nb});
+        orders[d].push_back({d, OP_W, nb});
+      }
     }
   }
   return orders;
@@ -148,7 +181,9 @@ enum Cols {
   COL_STORE_B_SLOT = 4,
   COL_BWD_V = 5, COL_BWD_M = 6,
   COL_BWD_ASLOT = 7, COL_BWD_GSLOT = 8,
-  N_COLS = 9,
+  COL_W_V = 9, COL_W_M = 10,
+  COL_W_ASLOT = 11, COL_W_GSLOT = 12,
+  N_COLS = 13,
 };
 
 }  // namespace
@@ -175,6 +210,11 @@ int dtpp_compile_schedule(const char* name, int D, int V, int M,
     if (M % num_rounds != 0)
       return fail(err, errlen, "Interleaved1F1B requires n_microbatches % num_rounds == 0");
     orders = interleaved_order(D, V, M);
+  } else if (sname == "ZBH1") {
+    if (V != 1) return fail(err, errlen, "ZBH1 supports a single stage per device");
+    if (D < 2) return fail(err, errlen, "ZBH1 requires n_devices >= 2");
+    if (M < D) return fail(err, errlen, "ZBH1 requires n_microbatches >= n_devices");
+    orders = zb_h1_order(D, M);
   } else {
     return fail(err, errlen, "unknown schedule: " + sname);
   }
@@ -198,17 +238,27 @@ int dtpp_compile_schedule(const char* name, int D, int V, int M,
       if (ptr[d] >= orders[d].size()) continue;
       const Action& a = orders[d][ptr[d]];
       bool ready;
-      if (!a.backward) {
+      if (a.op == OP_F) {
         if (a.stage == 0) {
           ready = true;
         } else {
-          auto it = done.find({a.stage - 1, false, a.mb});
+          auto it = done.find({a.stage - 1, OP_F, a.mb});
           ready = it != done.end() && it->second + 1 <= t;
         }
-      } else {
-        ready = done.count({a.stage, false, a.mb}) > 0;
+      } else if (a.op == OP_W) {
+        ready = done.count({a.stage, OP_F, a.mb}) > 0;
+        if (ready) {
+          if (a.stage == 0) {
+            auto it = done.find({1, OP_B, a.mb});
+            ready = it != done.end() && it->second + 1 <= t;
+          } else if (a.stage != S - 1) {
+            ready = done.count({a.stage, OP_B, a.mb}) > 0;
+          }
+        }
+      } else {  // OP_B
+        ready = done.count({a.stage, OP_F, a.mb}) > 0;
         if (ready && a.stage != S - 1) {
-          auto it = done.find({a.stage + 1, true, a.mb});
+          auto it = done.find({a.stage + 1, OP_B, a.mb});
           ready = it != done.end() && it->second + 1 <= t;
         }
       }
@@ -225,17 +275,27 @@ int dtpp_compile_schedule(const char* name, int D, int V, int M,
   std::vector<std::vector<std::tuple<int, int, std::pair<int, int>>>>
       act_events(D), grad_events(D);
   for (const auto& [a, ta] : done) {
-    if (a.backward) continue;
+    if (a.op != OP_F) continue;
     int d = a.stage % D;
-    int store = a.stage == 0 ? ta : done.at({a.stage - 1, false, a.mb}) + 1;
-    int release = done.at({a.stage, true, a.mb});
+    int store = a.stage == 0 ? ta : done.at({a.stage - 1, OP_F, a.mb}) + 1;
+    int release = -1;
+    auto itb = done.find({a.stage, OP_B, a.mb});
+    if (itb != done.end()) release = std::max(release, itb->second);
+    auto itw = done.find({a.stage, OP_W, a.mb});
+    if (itw != done.end()) release = std::max(release, itw->second);
     act_events[d].push_back({store, release, {a.stage, a.mb}});
   }
-  for (const auto& [a, ta] : done) {
-    if (!a.backward || a.stage == S - 1) continue;
-    int d = a.stage % D;
-    int store = done.at({a.stage + 1, true, a.mb}) + 1;
-    grad_events[d].push_back({store, ta, {a.stage, a.mb}});
+  for (int s = 0; s < S - 1; ++s) {
+    int d = s % D;
+    for (int m = 0; m < M; ++m) {
+      int store = done.at({s + 1, OP_B, m}) + 1;
+      int release = -1;
+      auto itb = done.find({s, OP_B, m});
+      if (itb != done.end()) release = std::max(release, itb->second);
+      auto itw = done.find({s, OP_W, m});
+      if (itw != done.end()) release = std::max(release, itw->second);
+      grad_events[d].push_back({store, release, {s, m}});
+    }
   }
   std::vector<SlotAlloc> act_alloc(D), grad_alloc(D);
   int n_act = 0, n_grad = 0;
@@ -257,7 +317,7 @@ int dtpp_compile_schedule(const char* name, int D, int V, int M,
   for (const auto& [a, ta] : done) {
     int d = a.stage % D;
     int v = a.stage / D;
-    if (!a.backward) {
+    if (a.op == OP_F) {
       cell(ta, d, COL_FWD_V) = v;
       cell(ta, d, COL_FWD_M) = a.mb;
       cell(ta, d, COL_FWD_SLOT) = act_alloc[d].assign.at({a.stage, a.mb});
@@ -266,7 +326,7 @@ int dtpp_compile_schedule(const char* name, int D, int V, int M,
         cell(ta + 1, nd, COL_STORE_F_SLOT) =
             act_alloc[nd].assign.at({a.stage + 1, a.mb});
       }
-    } else {
+    } else if (a.op == OP_B) {
       cell(ta, d, COL_BWD_V) = v;
       cell(ta, d, COL_BWD_M) = a.mb;
       cell(ta, d, COL_BWD_ASLOT) = act_alloc[d].assign.at({a.stage, a.mb});
@@ -277,6 +337,12 @@ int dtpp_compile_schedule(const char* name, int D, int V, int M,
         cell(ta + 1, pd, COL_STORE_B_SLOT) =
             grad_alloc[pd].assign.at({a.stage - 1, a.mb});
       }
+    } else {  // OP_W
+      cell(ta, d, COL_W_V) = v;
+      cell(ta, d, COL_W_M) = a.mb;
+      cell(ta, d, COL_W_ASLOT) = act_alloc[d].assign.at({a.stage, a.mb});
+      if (a.stage < S - 1)
+        cell(ta, d, COL_W_GSLOT) = grad_alloc[d].assign.at({a.stage, a.mb});
     }
   }
   // trim trailing all-empty ticks

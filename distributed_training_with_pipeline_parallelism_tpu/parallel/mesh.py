@@ -23,20 +23,21 @@ SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
 
 
+def _make_1d_mesh(n: int, axis_name: str, devices=None) -> "Mesh":
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n]), (axis_name,))
+
+
 def make_sp_mesh(n_seq: int, devices=None) -> "Mesh":
     """1-D sequence-parallel mesh for ring attention."""
-    devices = list(devices if devices is not None else jax.devices())
-    if len(devices) < n_seq:
-        raise ValueError(f"need {n_seq} devices, have {len(devices)}")
-    return Mesh(np.asarray(devices[:n_seq]), (SEQ_AXIS,))
+    return _make_1d_mesh(n_seq, SEQ_AXIS, devices)
 
 
 def make_ep_mesh(n_expert: int, devices=None) -> "Mesh":
     """1-D expert-parallel mesh for MoE all-to-all dispatch."""
-    devices = list(devices if devices is not None else jax.devices())
-    if len(devices) < n_expert:
-        raise ValueError(f"need {n_expert} devices, have {len(devices)}")
-    return Mesh(np.asarray(devices[:n_expert]), (EXPERT_AXIS,))
+    return _make_1d_mesh(n_expert, EXPERT_AXIS, devices)
 
 
 def make_mesh(n_pipe: int, n_data: int = 1,

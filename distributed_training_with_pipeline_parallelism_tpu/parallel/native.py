@@ -31,9 +31,20 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _lib_failed:
             return _lib
         try:
-            if not os.path.exists(_LIB_PATH):
+            # Invoke make when possible: it is mtime-incremental, so this is
+            # a no-op when the library is fresh and a rebuild when
+            # schedule_engine.cpp changed (e.g. a table-layout revision) — a
+            # stale .so would silently emit tables in the old layout. If no
+            # build toolchain is available but a prebuilt (and source-fresh)
+            # .so exists, load it anyway.
+            try:
                 subprocess.run(["make", "-C", os.path.abspath(_CSRC)],
                                check=True, capture_output=True)
+            except (OSError, subprocess.CalledProcessError):
+                src = os.path.join(_CSRC, "schedule_engine.cpp")
+                if not (os.path.exists(_LIB_PATH)
+                        and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)):
+                    raise
             lib = ctypes.CDLL(_LIB_PATH)
             lib.dtpp_compile_schedule.restype = ctypes.c_int
             lib.dtpp_compile_schedule.argtypes = [
@@ -58,15 +69,16 @@ def compile_schedule_native(name: str, n_devices: int, n_virtual: int,
     map — the table is the executor contract). Raises ScheduleError with the
     engine's message on invalid configs, RuntimeError if the library is
     unavailable."""
-    from .schedules import CompiledSchedule, ScheduleError, verify_table
+    from .schedules import (N_COLS, CompiledSchedule, ScheduleError,
+                            verify_table)
 
     lib = _load()
     if lib is None:
         raise RuntimeError("native schedule engine unavailable (no compiler?)")
     S = n_devices * n_virtual
-    n_actions = 2 * S * n_microbatches
+    n_actions = 3 * S * n_microbatches  # F + B + W upper bound
     cap_ticks = 4 * n_actions + 4 * S + 18
-    table = np.full((cap_ticks, n_devices, 9), -1, dtype=np.int32)
+    table = np.full((cap_ticks, n_devices, N_COLS), -1, dtype=np.int32)
     t_out = ctypes.c_int()
     n_act = ctypes.c_int()
     n_grad = ctypes.c_int()

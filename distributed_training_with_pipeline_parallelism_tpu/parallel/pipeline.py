@@ -50,7 +50,8 @@ from ..utils.config import ModelConfig, ScheduleConfig
 from .mesh import DATA_AXIS, PIPE_AXIS
 from .schedules import (COL_BWD_ASLOT, COL_BWD_GSLOT, COL_BWD_M, COL_BWD_V,
                         COL_FWD_M, COL_FWD_SLOT, COL_FWD_V, COL_STORE_B_SLOT,
-                        COL_STORE_F_SLOT, CompiledSchedule, compile_schedule)
+                        COL_STORE_F_SLOT, COL_W_ASLOT, COL_W_GSLOT, COL_W_M,
+                        COL_W_V, CompiledSchedule, compile_schedule)
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -134,7 +135,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     V = sched.n_virtual
     M = sched.n_microbatches
     cs: CompiledSchedule = _compile(sched.name, D, V, M)
-    table = jnp.asarray(cs.table)  # [T, D, 8]
+    split = cs.split_backward  # ZB-H1 family: B is dgrad-only, W carries wgrad
+    table = jnp.asarray(cs.table)  # [T, D, N_COLS]
     dtype = jnp.dtype(cfg.dtype)
     fwd_perm = [(i, (i + 1) % D) for i in range(D)]
     bwd_perm = [(i, (i - 1) % D) for i in range(D)]
@@ -169,6 +171,18 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             new = jnp.where(active, reg, buf[ss])
             return buf.at[ss].set(new)
 
+        def stage_objective(p_v, head_p, x_in, mm, last_stage, g_in):
+            """The scalar whose gradients are the stage VJP: the real loss
+            through the head on the last stage, else the contraction of the
+            stage output with the incoming cotangent."""
+            y = stage_body(p_v, x_in)
+            return jax.lax.cond(
+                last_stage,
+                lambda: cross_entropy_loss(
+                    head_apply(cfg, head_p, y), targets_mb[mm]),
+                lambda: jnp.sum(y.astype(jnp.float32)
+                                * g_in.astype(jnp.float32)))
+
         def tick(carry, row_all):
             (act_buf, grad_buf, fwd_recv, bwd_recv,
              g_layers, g_embed, g_head, loss_acc) = carry
@@ -199,6 +213,65 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             # 3. backward unit (rematerializing)
             bv, bm = row[COL_BWD_V], row[COL_BWD_M]
 
+            if split:
+                # Split backward (ZB-H1): B computes only the input cotangent
+                # (the half on the inter-stage critical path — upstream's
+                # stage_backward_input, _backward.py:177); W later redoes the
+                # stage VJP for parameter grads (stage_backward_weight,
+                # _backward.py:281) in ticks that would otherwise be bubble.
+                def dgrad_unit(loss_acc):
+                    vv, mm = jnp.maximum(bv, 0), jnp.maximum(bm, 0)
+                    last_stage = is_last_dev & (vv == V - 1)
+                    x = act_buf[jnp.maximum(row[COL_BWD_ASLOT], 0)]
+                    g_in = grad_buf[jnp.maximum(row[COL_BWD_GSLOT], 0)]
+                    params_v = select_v(layers_local, vv)
+                    loss_val, gx = jax.value_and_grad(
+                        lambda x_in: stage_objective(params_v, head, x_in, mm,
+                                                     last_stage, g_in))(x)
+                    return loss_acc + jnp.where(last_stage, loss_val, 0.0), gx
+
+                def dgrad_noop(loss_acc):
+                    return loss_acc, jnp.zeros(mb_shape, dtype)
+
+                loss_acc, bwd_send = jax.lax.cond(
+                    bm >= 0, dgrad_unit, dgrad_noop, loss_acc)
+
+                wv, wm = row[COL_W_V], row[COL_W_M]
+
+                def wgrad_unit(operand):
+                    g_layers, g_embed, g_head = operand
+                    vv, mm = jnp.maximum(wv, 0), jnp.maximum(wm, 0)
+                    last_stage = is_last_dev & (vv == V - 1)
+                    first_stage = is_first_dev & (vv == 0)
+                    x_slot = act_buf[jnp.maximum(row[COL_W_ASLOT], 0)]
+                    g_in = grad_buf[jnp.maximum(row[COL_W_GSLOT], 0)]
+                    params_v = select_v(layers_local, vv)
+
+                    def objective(p_v, head_p, emb_p):
+                        # First stage recomputes its input from the embedding
+                        # so wgrad flows into the embedding table too.
+                        x_emb = embed_apply(cfg, emb_p, tokens_mb[mm]).astype(dtype)
+                        x_in = jnp.where(first_stage, x_emb, x_slot)
+                        return stage_objective(p_v, head_p, x_in, mm,
+                                               last_stage, g_in)
+
+                    gp, gh, ge = jax.grad(objective, argnums=(0, 1, 2))(
+                        params_v, head, embed)
+                    g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
+                                            g_layers, gp)
+                    g_head = jax.tree.map(jnp.add, g_head, gh)
+                    g_embed = jax.tree.map(jnp.add, g_embed, ge)
+                    return (g_layers, g_embed, g_head)
+
+                (g_layers, g_embed, g_head) = jax.lax.cond(
+                    wm >= 0, wgrad_unit, lambda op: op,
+                    (g_layers, g_embed, g_head))
+
+                fwd_recv = jax.lax.ppermute(fwd_send, PIPE_AXIS, fwd_perm)
+                bwd_recv = jax.lax.ppermute(bwd_send, PIPE_AXIS, bwd_perm)
+                return (act_buf, grad_buf, fwd_recv, bwd_recv,
+                        g_layers, g_embed, g_head, loss_acc), None
+
             def bwd_unit(operand):
                 g_layers, g_embed, g_head, loss_acc = operand
                 vv, mm = jnp.maximum(bv, 0), jnp.maximum(bm, 0)
@@ -207,21 +280,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 x = act_buf[jnp.maximum(row[COL_BWD_ASLOT], 0)]
                 g_in = grad_buf[jnp.maximum(row[COL_BWD_GSLOT], 0)]
                 params_v = select_v(layers_local, vv)
-
-                def objective(p_v, head_p, x_in):
-                    y = stage_body(p_v, x_in)
-                    # Last stage: real loss through the head. Other stages:
-                    # contract with the incoming cotangent, whose gradient
-                    # w.r.t. (p_v, x_in) is exactly the VJP.
-                    return jax.lax.cond(
-                        last_stage,
-                        lambda: cross_entropy_loss(
-                            head_apply(cfg, head_p, y), targets_mb[mm]),
-                        lambda: jnp.sum(y.astype(jnp.float32)
-                                        * g_in.astype(jnp.float32)))
-
                 loss_val, (gp, gh, gx) = jax.value_and_grad(
-                    objective, argnums=(0, 1, 2))(params_v, head, x)
+                    lambda p_v, head_p, x_in: stage_objective(
+                        p_v, head_p, x_in, mm, last_stage, g_in),
+                    argnums=(0, 1, 2))(params_v, head, x)
 
                 g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
                                         g_layers, gp)

@@ -36,13 +36,16 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 F = "F"
-B = "B"
+B = "B"  # full backward — or input-grad (dgrad) only under a split schedule
+W = "W"  # weight-grad (wgrad) — split schedules (ZB-H1) only
+
+SPLIT_BACKWARD_SCHEDULES = frozenset({"ZBH1"})
 
 
 @dataclasses.dataclass(frozen=True)
 class Action:
     stage: int  # global stage index in [0, n_stages)
-    op: str  # F or B
+    op: str  # F, B, or W
     microbatch: int
 
 
@@ -152,8 +155,62 @@ def interleaved_order(n_devices: int, n_virtual: int,
     return orders
 
 
+def zb_h1_order(n_devices: int, n_microbatches: int) -> List[List[Action]]:
+    """ZB-H1 zero-bubble schedule (Qi et al., arXiv:2401.10241): the full
+    backward is split into an input-grad half ``B`` (on the critical path —
+    it unblocks the upstream stage) and a weight-grad half ``W`` (off the
+    critical path — it fills what would otherwise be bubble ticks).
+
+    Upstream torch.distributed.pipelining exposes exactly this split as
+    ``stage_backward_input`` / ``stage_backward_weight``
+    (``_backward.py:177,281`` — SURVEY.md U5); the reference's three
+    schedules never exercise it, so this schedule is beyond-parity.
+
+    Layout per device (V=1, stage == device): one extra warmup forward vs
+    1F1B (``D - d`` instead of ``D-1-d``) since dgrad-only backwards return
+    cotangents sooner; steady state is (B, W, F) triples; cooldown drains
+    (B, W) pairs. Stage 0 emits no ``B`` at all — it has no upstream to
+    send a cotangent to — and its ``W`` does the full parameter+embedding
+    backward.
+    """
+    D, M = n_devices, n_microbatches
+    if D < 2:
+        raise ScheduleError("ZBH1 requires n_devices >= 2 (loss lives on the "
+                            "last stage's dgrad unit, which stage 0 elides)")
+    if M < D:
+        raise ScheduleError(f"ZBH1 requires n_microbatches >= n_devices ({M} < {D})")
+    orders = []
+    for d in range(D):
+        warmup = min(M, D - d)
+        acts = [Action(d, F, m) for m in range(warmup)]
+        nf, nb = warmup, 0
+        if d == 0:
+            while nf < M:
+                acts.append(Action(0, W, nb))
+                nb += 1
+                acts.append(Action(0, F, nf))
+                nf += 1
+            acts += [Action(0, W, m) for m in range(nb, M)]
+        else:
+            while nf < M:
+                acts.append(Action(d, B, nb))
+                acts.append(Action(d, W, nb))
+                nb += 1
+                acts.append(Action(d, F, nf))
+                nf += 1
+            for m in range(nb, M):
+                acts.append(Action(d, B, m))
+                acts.append(Action(d, W, m))
+        orders.append(acts)
+    return orders
+
+
 def build_order(name: str, n_devices: int, n_virtual: int,
                 n_microbatches: int) -> List[List[Action]]:
+    if name == "ZBH1":
+        if n_virtual != 1:
+            raise ScheduleError("ZBH1 supports a single stage per device")
+        return zb_h1_order(n_devices, n_microbatches)
     if name == "GPipe":
         if n_virtual != 1:
             raise ScheduleError("GPipe supports a single stage per device")
@@ -205,9 +262,19 @@ def schedule_ticks(orders: List[List[Action]], n_devices: int, n_virtual: int,
             # one tick of ppermute latency (for D == 1 the +1 is subsumed by
             # one-action-per-tick, so the same rule applies)
             return dep in done and done[dep] + 1 <= now
-        # backward
         if Action(a.stage, F, a.microbatch) not in done:
             return False
+        if a.op == W:
+            # wgrad: needs the incoming cotangent. Stage 0 (no B of its own)
+            # waits for the ppermute arrival from B(1, m); other stages'
+            # same-device B already proved the cotangent is banked.
+            if a.stage == 0:
+                dep = Action(1, B, a.microbatch)
+                return dep in done and done[dep] + 1 <= now
+            if a.stage == S - 1:
+                return True  # CE recompute needs no incoming cotangent
+            return Action(a.stage, B, a.microbatch) in done
+        # backward (full or dgrad)
         if a.stage == S - 1:
             return True
         dep = Action(a.stage + 1, B, a.microbatch)
@@ -230,9 +297,11 @@ def schedule_ticks(orders: List[List[Action]], n_devices: int, n_virtual: int,
 
 
 def validate_order(orders: List[List[Action]], n_devices: int, n_virtual: int,
-                   n_microbatches: int) -> None:
-    """Structural validation: each (stage, microbatch) has exactly one F and
-    one B, F precedes B per device, and the tick scheduler completes."""
+                   n_microbatches: int, split_backward: bool = False) -> None:
+    """Structural validation: every (stage, microbatch) has exactly one F and
+    one full B (or, under a split schedule, one W plus one dgrad B for every
+    stage except 0), F precedes B/W per device, and the tick scheduler
+    completes."""
     S = n_devices * n_virtual
     seen: Dict[Action, int] = {}
     for d, order in enumerate(orders):
@@ -243,13 +312,21 @@ def validate_order(orders: List[List[Action]], n_devices: int, n_virtual: int,
             seen[a] = d
             pos[a] = i
         for a in order:
-            if a.op == B:
+            if a.op in (B, W):
                 fa = Action(a.stage, F, a.microbatch)
                 if fa not in pos or pos[fa] > pos[a]:
                     raise ScheduleError(f"backward before forward: {a}")
-    expect = 2 * S * n_microbatches
-    if len(seen) != expect:
-        raise ScheduleError(f"expected {expect} actions, got {len(seen)}")
+    want = {Action(s, F, m) for s in range(S) for m in range(n_microbatches)}
+    if split_backward:
+        want |= {Action(s, W, m) for s in range(S) for m in range(n_microbatches)}
+        want |= {Action(s, B, m) for s in range(1, S) for m in range(n_microbatches)}
+    else:
+        want |= {Action(s, B, m) for s in range(S) for m in range(n_microbatches)}
+    if set(seen) != want:
+        raise ScheduleError(
+            f"action set mismatch: {len(seen)} actions vs expected {len(want)} "
+            f"(missing {list(want - set(seen))[:4]}, "
+            f"extra {list(set(seen) - want)[:4]})")
     schedule_ticks(orders, n_devices, n_virtual)  # raises on deadlock
 
 
@@ -266,7 +343,9 @@ COL_FWD_V, COL_FWD_M, COL_FWD_SLOT = 1, 2, 3  # forward unit: (v, m), input slot
 COL_STORE_B_SLOT = 4  # store incoming grad -> grad_buf[slot]
 COL_BWD_V, COL_BWD_M = 5, 6  # backward unit: (v, m)
 COL_BWD_ASLOT, COL_BWD_GSLOT = 7, 8  # saved-input slot, incoming-grad slot
-N_COLS = 9
+COL_W_V, COL_W_M = 9, 10  # weight-grad unit (split schedules): (v, m)
+COL_W_ASLOT, COL_W_GSLOT = 11, 12  # its saved-input slot, incoming-grad slot
+N_COLS = 13
 
 
 @dataclasses.dataclass(frozen=True)
@@ -284,6 +363,12 @@ class CompiledSchedule:
     @property
     def n_stages(self) -> int:
         return self.n_devices * self.n_virtual
+
+    @property
+    def split_backward(self) -> bool:
+        """True when B actions are dgrad-only and W actions carry the
+        parameter gradients (ZB-H1 family)."""
+        return self.name in SPLIT_BACKWARD_SCHEDULES
 
 
 def _allocate_slots(events: List[Tuple[int, int, object]]) -> Tuple[Dict[object, int], int]:
@@ -326,8 +411,9 @@ def compile_schedule(name: str, n_devices: int, n_virtual: int,
     :func:`verify_table` (a symbolic interpreter) before being returned.
     """
     D, V, M = n_devices, n_virtual, n_microbatches
+    split = name in SPLIT_BACKWARD_SCHEDULES
     orders = build_order(name, D, V, M)
-    validate_order(orders, D, V, M)
+    validate_order(orders, D, V, M, split_backward=split)
     ticks, T_compute = schedule_ticks(orders, D, V)
     S = D * V
     # +1: arrivals land one tick after the producing compute; the final
@@ -338,7 +424,9 @@ def compile_schedule(name: str, n_devices: int, n_virtual: int,
     # Activation lifetimes per device: input of stage s for microbatch m is
     # written at the producer's tick + 1 (arrival) — or at the forward tick
     # itself for global stage 0 (the embed is computed in place) — and last
-    # read by B(s, m). Grad lifetimes: written at B(s+1, m) + 1, read by B(s, m).
+    # read by B(s, m), or by W(s, m) under a split schedule (W runs after B
+    # by list order, so W is the releasing read). Grad lifetimes: written at
+    # B(s+1, m) + 1, last read by whichever of B(s, m) / W(s, m) runs later.
     act_events: List[List[Tuple[int, int, object]]] = [[] for _ in range(D)]
     grad_events: List[List[Tuple[int, int, object]]] = [[] for _ in range(D)]
     for a, t in ticks.items():
@@ -346,14 +434,17 @@ def compile_schedule(name: str, n_devices: int, n_virtual: int,
             continue
         d = a.stage % D
         store = t if a.stage == 0 else ticks[Action(a.stage - 1, F, a.microbatch)] + 1
-        release = ticks[Action(a.stage, B, a.microbatch)]
+        release = max(ticks[r] for r in (Action(a.stage, B, a.microbatch),
+                                         Action(a.stage, W, a.microbatch))
+                      if r in ticks)
         act_events[d].append((store, release, (a.stage, a.microbatch)))
-    for a, t in ticks.items():
-        if a.op != B or a.stage == S - 1:
-            continue
-        d = a.stage % D
-        store = ticks[Action(a.stage + 1, B, a.microbatch)] + 1
-        grad_events[d].append((store, t, (a.stage, a.microbatch)))
+    for s in range(S - 1):
+        d = s % D
+        for m in range(M):
+            store = ticks[Action(s + 1, B, m)] + 1
+            release = max(ticks[r] for r in (Action(s, B, m), Action(s, W, m))
+                          if r in ticks)
+            grad_events[d].append((store, release, (s, m)))
 
     act_assign, n_act = [], 0
     grad_assign, n_grad = [], 0
@@ -379,7 +470,7 @@ def compile_schedule(name: str, n_devices: int, n_virtual: int,
                 nd = (a.stage + 1) % D
                 nslot = act_assign[nd][(a.stage + 1, a.microbatch)]
                 table[t + 1, nd, COL_STORE_F_SLOT] = nslot
-        else:
+        elif a.op == B:
             table[t, d, COL_BWD_V] = v
             table[t, d, COL_BWD_M] = a.microbatch
             table[t, d, COL_BWD_ASLOT] = act_assign[d][(a.stage, a.microbatch)]
@@ -389,6 +480,12 @@ def compile_schedule(name: str, n_devices: int, n_virtual: int,
                 pd = (a.stage - 1) % D
                 pslot = grad_assign[pd][(a.stage - 1, a.microbatch)]
                 table[t + 1, pd, COL_STORE_B_SLOT] = pslot
+        else:  # W (wgrad)
+            table[t, d, COL_W_V] = v
+            table[t, d, COL_W_M] = a.microbatch
+            table[t, d, COL_W_ASLOT] = act_assign[d][(a.stage, a.microbatch)]
+            if a.stage < S - 1:
+                table[t, d, COL_W_GSLOT] = grad_assign[d][(a.stage, a.microbatch)]
     # Trim trailing all-empty ticks (keeps the executor scan minimal).
     while T > 1 and np.all(table[T - 1] == -1):
         T -= 1
@@ -410,6 +507,7 @@ def verify_table(cs: CompiledSchedule) -> None:
     bwd_in = [None] * D
     fwd_done = set()
     bwd_done = set()
+    w_done = set()
     for t in range(cs.table.shape[0]):
         fwd_send = [None] * D
         bwd_send = [None] * D
@@ -454,10 +552,32 @@ def verify_table(cs: CompiledSchedule) -> None:
                             f"{gslot} holds {gg}")
                 bwd_send[d] = ("gout", s - 1, m) if s > 0 else None
                 bwd_done.add((s, m))
+            if row[COL_W_M] >= 0:
+                s = int(row[COL_W_V]) * D + d
+                m = int(row[COL_W_M])
+                aslot = int(row[COL_W_ASLOT])
+                got = act[d].get(aslot)
+                if got != ("act", s, m):
+                    raise ScheduleError(
+                        f"t={t} d={d}: W(stage={s}, mb={m}) saved-input slot "
+                        f"{aslot} holds {got}")
+                if s < S - 1:
+                    gslot = int(row[COL_W_GSLOT])
+                    gg = grad[d].get(gslot)
+                    if gg != ("gout", s, m):
+                        raise ScheduleError(
+                            f"t={t} d={d}: W(stage={s}, mb={m}) grad slot "
+                            f"{gslot} holds {gg}")
+                w_done.add((s, m))
         fwd_in = [fwd_send[(d - 1) % D] for d in range(D)]
         bwd_in = [bwd_send[(d + 1) % D] for d in range(D)]
     want = {(s, m) for s in range(S) for m in range(cs.n_microbatches)}
-    if fwd_done != want or bwd_done != want:
+    if cs.split_backward:
+        want_b = {(s, m) for s in range(1, S) for m in range(cs.n_microbatches)}
+        ok = fwd_done == want and bwd_done == want_b and w_done == want
+    else:
+        ok = fwd_done == want and bwd_done == want and not w_done
+    if not ok:
         raise ScheduleError("table does not execute every (stage, microbatch)")
 
 
@@ -474,24 +594,32 @@ def analytic_bubble_fraction(name: str, n_devices: int, n_virtual: int,
     matches GPipe's bubble; its win is activation memory, SURVEY.md §6 note).
     Interleaved: warmup/cooldown offsets stay proportional to D-1 while
     per-device work grows to 2MV ticks -> (D-1)/(M*V + D-1).
+    ZB-H1: per-device work is 3M unit ticks (F + dgrad + wgrad) against the
+    same ~(D-1) ramp -> (D-1)/(3M + D-1); with dgrad~wgrad~F~1 this is the
+    tick-model analog of the paper's bubble reduction (the weighted win over
+    1F1B shows in :func:`simulated_bubble` with w_b=w_w=1 vs full w_b=2).
     """
     D, M = n_devices, n_microbatches
+    if name == "ZBH1":
+        return (D - 1) / (3 * M + D - 1)
     V = n_virtual if name == "Interleaved1F1B" else 1
     return (D - 1) / (M * V + D - 1)
 
 
 def simulated_bubble(cs: CompiledSchedule, w_f: float = 1.0,
-                     w_b: float = 2.0) -> Dict[str, float]:
+                     w_b: float = 2.0, w_w: float = 1.0) -> Dict[str, float]:
     """Bubble measured on the compiled tick schedule under a cost model where
-    a forward tick costs ``w_f`` and a backward tick ``w_b`` (backward ~2x
+    a forward tick costs ``w_f``, a backward tick ``w_b`` (full backward ~2x
     forward; the executor's rematerializing backward is ~3x — pass w_b=3.0
-    for that model). Lockstep SPMD: each tick lasts as long as its most
-    expensive active device."""
+    for that model; for split schedules B is dgrad-only, so pass w_b~=w_f)
+    and a wgrad tick ``w_w``. Lockstep SPMD: each tick lasts as long as its
+    most expensive active device."""
     T = cs.makespan
     tick_cost = np.zeros(T + 1)
     busy = np.zeros(cs.n_devices)
+    weight = {F: w_f, B: w_b, W: w_w}
     for a, t in cs.ticks.items():
-        w = w_f if a.op == F else w_b
+        w = weight[a.op]
         d = a.stage % cs.n_devices
         tick_cost[t] = max(tick_cost[t], w)
         busy[d] += w

@@ -17,14 +17,16 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from distributed_training_with_pipeline_parallelism_tpu.utils.config import (  # noqa: E402
+    SCHEDULE_NAMES)
+
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt2-small",
                     help="gpt2-{small,medium,large,xl}, llama2-7b, llama3-8b, "
                          "llama-debug, or ref (the reference parity model)")
-    ap.add_argument("--schedule", default="1F1B",
-                    choices=["GPipe", "1F1B", "Interleaved1F1B"])
+    ap.add_argument("--schedule", default="1F1B", choices=list(SCHEDULE_NAMES))
     ap.add_argument("--pipe", type=int, default=2)
     ap.add_argument("--data", type=int, default=1)
     ap.add_argument("--virtual", type=int, default=1)
