@@ -41,10 +41,12 @@ def _load() -> Optional[ctypes.CDLL]:
                 subprocess.run(["make", "-C", os.path.abspath(_CSRC)],
                                check=True, capture_output=True)
             except (OSError, subprocess.CalledProcessError):
-                src = os.path.join(_CSRC, "schedule_engine.cpp")
-                if not (os.path.exists(_LIB_PATH)
-                        and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)):
+                if not os.path.exists(_LIB_PATH):
                     raise
+                src = os.path.join(_CSRC, "schedule_engine.cpp")
+                if (os.path.exists(src)
+                        and os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
+                    raise  # .so is stale relative to the source; don't trust it
             lib = ctypes.CDLL(_LIB_PATH)
             lib.dtpp_compile_schedule.restype = ctypes.c_int
             lib.dtpp_compile_schedule.argtypes = [

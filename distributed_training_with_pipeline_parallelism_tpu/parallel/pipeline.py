@@ -246,21 +246,23 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     x_slot = act_buf[jnp.maximum(row[COL_W_ASLOT], 0)]
                     g_in = grad_buf[jnp.maximum(row[COL_W_GSLOT], 0)]
                     params_v = select_v(layers_local, vv)
-
-                    def objective(p_v, head_p, emb_p):
-                        # First stage recomputes its input from the embedding
-                        # so wgrad flows into the embedding table too.
-                        x_emb = embed_apply(cfg, emb_p, tokens_mb[mm]).astype(dtype)
-                        x_in = jnp.where(first_stage, x_emb, x_slot)
-                        return stage_objective(p_v, head_p, x_in, mm,
-                                               last_stage, g_in)
-
-                    gp, gh, ge = jax.grad(objective, argnums=(0, 1, 2))(
-                        params_v, head, embed)
+                    gp, gh, gx = jax.grad(
+                        lambda p_v, head_p, x_in: stage_objective(
+                            p_v, head_p, x_in, mm, last_stage, g_in),
+                        argnums=(0, 1, 2))(params_v, head, x_slot)
                     g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
                                             g_layers, gp)
                     g_head = jax.tree.map(jnp.add, g_head, gh)
-                    g_embed = jax.tree.map(jnp.add, g_embed, ge)
+                    # Embedding wgrad only on the first stage (its saved input
+                    # IS the embed output, so gx is the embed cotangent).
+                    g_embed = jax.lax.cond(
+                        first_stage,
+                        lambda: jax.tree.map(
+                            jnp.add, g_embed,
+                            jax.grad(lambda e: jnp.vdot(
+                                embed_apply(cfg, e, tokens_mb[mm]).astype(jnp.float32),
+                                gx.astype(jnp.float32)))(embed)),
+                        lambda: g_embed)
                     return (g_layers, g_embed, g_head)
 
                 (g_layers, g_embed, g_head) = jax.lax.cond(

@@ -1,0 +1,135 @@
+"""Data pipeline: token datasets, host-side batching, device prefetch.
+
+The reference's entire data story is one line — random token tensors built
+once per worker (``LLMsDistributedTrainingHelper.py:191-194``) and reused
+for every iteration. :func:`synthetic_batches` reproduces that regime and
+backs ``utils.train.synthetic_data``. Beyond parity, real-model training
+on the GPT-2/Llama ladder needs an actual input pipeline, TPU-shaped:
+
+- **Memory-mapped token files** (:class:`TokenFileDataset`): flat binary
+  arrays of token ids (the standard GPT-2-style ``.bin`` format) sampled by
+  random crop. ``np.memmap`` keeps the host working set at O(touched pages)
+  regardless of corpus size; no native loader is needed because the hot
+  path is the kernel's page cache, not Python.
+- **Sharded device placement** (:func:`batch_sharding`): batches are laid
+  out over the mesh's data axis before the train step runs, so jit consumes
+  committed on-device arrays instead of re-transferring host buffers every
+  step.
+- **Prefetch** (:func:`prefetch_to_device`): a depth-k deque of in-flight
+  ``device_put`` transfers. ``device_put`` is async under JAX — enqueueing
+  the next batch while the current step computes overlaps PCIe/DMA with MXU
+  work; depth 2 is the classic double buffer.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS
+
+Batch = Tuple[jax.Array, jax.Array]  # (tokens, targets), both [B, S]
+
+
+def synthetic_batches(vocab_size: int, batch_size: int, seq_length: int,
+                      seed: int = 0, next_token_targets: bool = True,
+                      ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Endless random-token batches — the reference's data regime.
+
+    ``next_token_targets=True`` yields targets shifted by one (so training
+    can actually reduce loss); ``False`` reproduces the reference exactly
+    (independent random targets, loss pinned at the entropy floor —
+    ``LLMsDistributedTrainingHelper.py:191-194``).
+    """
+    rng = np.random.default_rng(seed)
+    while True:
+        if next_token_targets:
+            toks = rng.integers(0, vocab_size,
+                                (batch_size, seq_length + 1), dtype=np.int32)
+            yield toks[:, :-1], toks[:, 1:]
+        else:
+            yield (rng.integers(0, vocab_size, (batch_size, seq_length),
+                                dtype=np.int32),
+                   rng.integers(0, vocab_size, (batch_size, seq_length),
+                                dtype=np.int32))
+
+
+class TokenFileDataset:
+    """Random-crop sampler over a flat binary token file.
+
+    ``path`` holds token ids as a flat array of ``dtype`` (uint16 fits any
+    vocab < 65536 — the standard packed-corpus format). Batches are
+    independent random crops of ``seq_length + 1`` tokens; targets are the
+    crop shifted by one.
+    """
+
+    def __init__(self, path: str, seq_length: int,
+                 dtype: np.dtype = np.uint16, seed: int = 0):
+        self.tokens = np.memmap(path, dtype=dtype, mode="r")
+        if len(self.tokens) < seq_length + 1:
+            raise ValueError(
+                f"{path} holds {len(self.tokens)} tokens, need at least "
+                f"{seq_length + 1}")
+        self.seq_length = seq_length
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    def sample(self, batch_size: int) -> Tuple[np.ndarray, np.ndarray]:
+        starts = self._rng.integers(
+            0, len(self.tokens) - self.seq_length - 1, batch_size)
+        crops = np.stack([
+            np.asarray(self.tokens[s: s + self.seq_length + 1])
+            for s in starts]).astype(np.int32)
+        return crops[:, :-1], crops[:, 1:]
+
+    def batches(self, batch_size: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        while True:
+            yield self.sample(batch_size)
+
+
+def write_token_file(path: str, tokens: np.ndarray,
+                     dtype: np.dtype = np.uint16) -> None:
+    """Pack a 1-D token-id array into the flat binary format."""
+    np.asarray(tokens, dtype=dtype).tofile(path)
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> Optional[NamedSharding]:
+    """Sharding for [B, S] batches: batch dim split over the mesh's data
+    axis (replicated over the other axes). Returns None if the mesh has no
+    such axis (single-group case — plain device_put suffices)."""
+    if axis not in mesh.shape:
+        return None
+    return NamedSharding(mesh, P(axis))
+
+
+def prefetch_to_device(it: Iterator, depth: int = 2,
+                       sharding: Optional[NamedSharding] = None,
+                       ) -> Iterator[Batch]:
+    """Keep ``depth`` batches in flight to the device(s).
+
+    ``device_put`` enqueues an async transfer; holding a deque of pending
+    batches overlaps host->HBM DMA for batch k+1 with compute on batch k.
+    With ``sharding`` set, arrays land pre-sharded over the mesh so the
+    jitted step performs zero input resharding.
+    """
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1, got {depth}")
+    queue: collections.deque = collections.deque()
+
+    def put(batch):
+        if sharding is not None:
+            return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+        return jax.tree.map(jax.device_put, batch)
+
+    for batch in it:
+        queue.append(put(batch))
+        if len(queue) >= depth:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

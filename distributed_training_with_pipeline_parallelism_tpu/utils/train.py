@@ -84,10 +84,9 @@ def synthetic_data(cfg: ModelConfig, batch_size: int, seq_length: int,
     """Random-token batches, the reference's data regime. Targets are the
     inputs shifted by one (next-token prediction), unlike the reference's
     independent random targets — random targets make loss a constant-entropy
-    floor, which is useless for verifying that optimization works."""
-    key = jax.random.key(seed)
-    while True:
-        key, k = jax.random.split(key)
-        toks = jax.random.randint(k, (batch_size, seq_length + 1), 0,
-                                  cfg.vocab_size)
-        yield toks[:, :-1], toks[:, 1:]
+    floor, which is useless for verifying that optimization works.
+
+    Thin wrapper over :func:`.data.synthetic_batches` (the single
+    implementation of the regime) with the model config supplying vocab."""
+    from .data import synthetic_batches
+    return synthetic_batches(cfg.vocab_size, batch_size, seq_length, seed=seed)

@@ -47,6 +47,11 @@ def main():
     ap.add_argument("--layers", type=int, default=0)
     ap.add_argument("--heads", type=int, default=0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--data-file", default="",
+                    help="flat binary token file (uint16 ids); default is "
+                         "the reference's synthetic random-token regime")
+    ap.add_argument("--prefetch", type=int, default=2,
+                    help="device-prefetch depth (0 disables)")
     args = ap.parse_args()
 
     if args.simulate_devices:
@@ -100,7 +105,16 @@ def main():
     else:
         params = tfm.transformer_init(jax.random.key(args.seed), cfg)
 
-    data = train.synthetic_data(cfg, args.batch, args.seq, seed=args.seed)
+    from distributed_training_with_pipeline_parallelism_tpu.utils.data import (
+        TokenFileDataset, batch_sharding, prefetch_to_device)
+    if args.data_file:
+        data = TokenFileDataset(args.data_file, args.seq,
+                                seed=args.seed).batches(args.batch)
+    else:
+        data = train.synthetic_data(cfg, args.batch, args.seq, seed=args.seed)
+    if args.prefetch > 0:
+        data = prefetch_to_device(data, depth=args.prefetch,
+                                  sharding=batch_sharding(mesh))
     optimizer = train.adamw(learning_rate=args.lr, total_steps=args.steps)
     params, history = train.fit(cfg, mesh, sched, params, data, args.steps,
                                 optimizer=optimizer, log_every=max(1, args.steps // 20))

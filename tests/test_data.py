@@ -1,0 +1,83 @@
+"""Data-pipeline units: token-file sampling, synthetic stream, prefetch and
+sharded placement on the simulated mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+    make_mesh)
+from distributed_training_with_pipeline_parallelism_tpu.utils.data import (
+    TokenFileDataset, batch_sharding, prefetch_to_device, synthetic_batches,
+    write_token_file)
+
+
+def test_synthetic_next_token_targets():
+    it = synthetic_batches(vocab_size=50, batch_size=4, seq_length=8, seed=1)
+    toks, tgts = next(it)
+    assert toks.shape == (4, 8) and tgts.shape == (4, 8)
+    np.testing.assert_array_equal(toks[:, 1:], tgts[:, :-1])  # shifted by one
+    assert toks.max() < 50 and toks.min() >= 0
+
+
+def test_synthetic_reference_regime_independent_targets():
+    it = synthetic_batches(vocab_size=50, batch_size=4, seq_length=8, seed=1,
+                           next_token_targets=False)
+    toks, tgts = next(it)
+    assert not np.array_equal(toks[:, 1:], tgts[:, :-1])
+
+
+def test_synthetic_deterministic_by_seed():
+    a = next(synthetic_batches(50, 4, 8, seed=7))
+    b = next(synthetic_batches(50, 4, 8, seed=7))
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+
+
+def test_token_file_roundtrip(tmp_path):
+    path = str(tmp_path / "corpus.bin")
+    corpus = np.arange(1000) % 97
+    write_token_file(path, corpus)
+    ds = TokenFileDataset(path, seq_length=16, seed=0)
+    assert len(ds) == 1000
+    toks, tgts = ds.sample(8)
+    assert toks.shape == (8, 16) and tgts.shape == (8, 16)
+    np.testing.assert_array_equal(toks[:, 1:], tgts[:, :-1])
+    # crops really come from the corpus: consecutive mod-97 runs
+    np.testing.assert_array_equal((toks[:, :-1] + 1) % 97, toks[:, 1:] % 97)
+
+
+def test_token_file_too_small_raises(tmp_path):
+    path = str(tmp_path / "tiny.bin")
+    write_token_file(path, np.arange(4))
+    with pytest.raises(ValueError):
+        TokenFileDataset(path, seq_length=16)
+
+
+def test_prefetch_preserves_order_and_values():
+    batches = [(np.full((2, 4), i), np.full((2, 4), -i)) for i in range(7)]
+    out = list(prefetch_to_device(iter(batches), depth=2))
+    assert len(out) == 7
+    for i, (t, y) in enumerate(out):
+        assert isinstance(t, jax.Array)
+        np.testing.assert_array_equal(np.asarray(t), batches[i][0])
+        np.testing.assert_array_equal(np.asarray(y), batches[i][1])
+
+
+def test_prefetch_sharded_placement():
+    mesh = make_mesh(n_pipe=2, n_data=2)
+    sh = batch_sharding(mesh)
+    assert sh is not None
+    it = synthetic_batches(50, batch_size=8, seq_length=4, seed=0)
+    toks, _ = next(prefetch_to_device(it, depth=1, sharding=sh))
+    assert toks.sharding == sh
+    # batch dim split over data axis (2 shards of 4 rows, each on 2 devices)
+    shard_shapes = {s.data.shape for s in toks.addressable_shards}
+    assert shard_shapes == {(4, 4)}
+
+
+def test_batch_sharding_no_data_axis_returns_none():
+    mesh = make_mesh(n_pipe=4, n_data=1)
+    # 'data' axis exists but size 1 — sharding still valid; drop only when absent
+    assert batch_sharding(mesh, axis="nonexistent") is None
