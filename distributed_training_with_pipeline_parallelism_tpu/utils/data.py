@@ -81,8 +81,9 @@ class TokenFileDataset:
         return len(self.tokens)
 
     def sample(self, batch_size: int) -> Tuple[np.ndarray, np.ndarray]:
+        # valid crop starts: 0 .. len - (seq_length+1) inclusive
         starts = self._rng.integers(
-            0, len(self.tokens) - self.seq_length - 1, batch_size)
+            0, len(self.tokens) - self.seq_length, batch_size)
         crops = np.stack([
             np.asarray(self.tokens[s: s + self.seq_length + 1])
             for s in starts]).astype(np.int32)

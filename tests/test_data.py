@@ -48,6 +48,16 @@ def test_token_file_roundtrip(tmp_path):
     np.testing.assert_array_equal((toks[:, :-1] + 1) % 97, toks[:, 1:] % 97)
 
 
+def test_token_file_exact_minimum_size(tmp_path):
+    # a corpus of exactly seq_length+1 tokens has one valid crop
+    path = str(tmp_path / "min.bin")
+    write_token_file(path, np.arange(17))
+    ds = TokenFileDataset(path, seq_length=16, seed=0)
+    toks, tgts = ds.sample(3)
+    np.testing.assert_array_equal(toks, np.tile(np.arange(16), (3, 1)))
+    np.testing.assert_array_equal(tgts, np.tile(np.arange(1, 17), (3, 1)))
+
+
 def test_token_file_too_small_raises(tmp_path):
     path = str(tmp_path / "tiny.bin")
     write_token_file(path, np.arange(4))
