@@ -9,9 +9,9 @@ batch * seq * iters / elapsed in tokens/sec.
 
 Baseline: the reference's GPipe L8/H8 2-process run on 10-core CPU/gloo =
 1671.32 tok/s (BASELINE.md, notebook cell 25). Here the same schedule
-machinery runs on however many chips are visible (a 1-chip mesh degenerates
-to a self-ring but still executes the full tick program, remat backward and
-all).
+machinery runs on however many chips are visible; a 1-chip mesh is the
+degenerate 1-stage pipeline, which the executor lowers to the equivalent
+fused full-batch step (identical loss/grads, tested).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -47,16 +47,23 @@ def run(batch_size: int = 32, seq_length: int = 128, num_iterations: int = 20,
     targets = jax.random.randint(jax.random.key(2), (batch_size, seq_length),
                                  0, cfg.vocab_size)
 
-    for _ in range(2):  # warmup, untimed (reference :113-118)
-        jax.block_until_ready(step(params, tokens, targets))
+    from distributed_training_with_pipeline_parallelism_tpu.utils.metrics import (
+        force_completion)
 
-    # median of 3 measurement windows (the device tunnel is jittery)
+    for _ in range(2):  # warmup, untimed (reference :113-118)
+        force_completion(step(params, tokens, targets))
+
+    # Median of 3 measurement windows (the device tunnel is jittery). Each
+    # window ends with a host fetch of the final loss: block_until_ready is
+    # not a reliable execution barrier through the remote-device tunnel, but
+    # a device-to-host read of the last step's output cannot complete before
+    # the FIFO device queue drains.
     elapsed_runs = []
     for _ in range(3):
         start = time.perf_counter()
         for _ in range(num_iterations):
             loss, grads = step(params, tokens, targets)
-        jax.block_until_ready((loss, grads))
+        force_completion(loss)
         elapsed_runs.append(time.perf_counter() - start)
     elapsed = sorted(elapsed_runs)[1]
 

@@ -44,7 +44,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.transformer import (body_apply, embed_apply, head_apply)
+from ..models.transformer import (body_apply, embed_apply, head_apply,
+                                  transformer_loss)
 from ..ops.layers import cross_entropy_loss
 from ..utils.config import ModelConfig, ScheduleConfig
 from .mesh import DATA_AXIS, PIPE_AXIS
@@ -118,6 +119,7 @@ def unstack_stage_layers(stacked: Pytree) -> Pytree:
 
 
 def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
+                          force_tick_executor: bool = False,
                           ) -> Callable[[Pytree, jax.Array, jax.Array],
                                         Tuple[jax.Array, Pytree]]:
     """Build an (unjitted) ``(params, tokens, targets) -> (loss, grads)``
@@ -135,6 +137,21 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     V = sched.n_virtual
     M = sched.n_microbatches
     cs: CompiledSchedule = _compile(sched.name, D, V, M)
+    if D == 1 and n_data == 1 and V == 1 and not force_tick_executor:
+        # Degenerate 1-stage pipeline == a plain full-batch train step: the
+        # microbatch-accumulated, 1/M-scaled loss/grads equal the full-batch
+        # mean exactly (asserted in tests/test_pipeline.py), so skip the tick
+        # machinery and its rematerializing backward entirely and let XLA
+        # fuse the whole step. The schedule was still compiled above, so
+        # invalid (name, D, V, M) combinations raise identically.
+        def degenerate_step(params, tokens, targets):
+            # same config contract as the tick executor's shard_map assert
+            assert tokens.shape[0] % M == 0, (
+                f"batch {tokens.shape[0]} not divisible by n_microbatches={M}")
+            return jax.value_and_grad(
+                lambda p: transformer_loss(cfg, p, tokens, targets))(params)
+
+        return degenerate_step
     split = cs.split_backward  # ZB-H1 family: B is dgrad-only, W carries wgrad
     table = jnp.asarray(cs.table)  # [T, D, N_COLS]
     dtype = jnp.dtype(cfg.dtype)
@@ -365,12 +382,16 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
 
 def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
+                       force_tick_executor: bool = False,
                        ) -> Callable[[Pytree, jax.Array, jax.Array],
                                      Tuple[jax.Array, Pytree]]:
     """Jitted ``(params, tokens, targets) -> (loss, grads)`` pipeline step.
 
     Matching the reference's measurement semantics (SURVEY.md §3.3 note): the
     step computes loss and gradients only — no optimizer update — so it can be
-    timed exactly like ``schedule.step``.
+    timed exactly like ``schedule.step``. ``force_tick_executor`` keeps the
+    tick program even in the degenerate 1-device case (used by bubble
+    measurement, where the comparator must pay the same remat cost).
     """
-    return jax.jit(make_pipeline_grad_fn(cfg, mesh, sched))
+    return jax.jit(make_pipeline_grad_fn(cfg, mesh, sched,
+                                         force_tick_executor=force_tick_executor))

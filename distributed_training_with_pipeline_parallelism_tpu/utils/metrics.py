@@ -8,9 +8,13 @@ note), throughput = batch * seq * iters / elapsed, and the same result dict
 ``{"elapsed_time", "throughput", "tokens_processed"}``.
 
 In SPMD there is no rank-role dispatch (the reference feeds x on rank 0 and
-target=y on the last rank): every device runs the same program, and
-``jax.block_until_ready`` around the timed loop gives the honest wall-clock
-the reference gets from process joins.
+target=y on the last rank): every device runs the same program. Honest
+wall-clock (the reference gets it from process joins) comes from
+:func:`force_completion` — fetching an output scalar to the host — because
+``jax.block_until_ready`` alone does not reliably wait for execution through
+remote-device tunnels (observed: it returned in ~0.3 ms for a ~20 ms step);
+a device-to-host read of the last step's output cannot complete before the
+FIFO device queue drains.
 """
 
 from __future__ import annotations
@@ -19,6 +23,19 @@ import time
 from typing import Callable, Dict
 
 import jax
+
+
+def force_completion(out) -> None:
+    """Force real completion of every computation enqueued so far by reading
+    the smallest *array* leaf of ``out`` (for a ``(loss, grads)`` pair: the
+    scalar loss) back to the host. Non-array leaves can't synchronize, so
+    they are ignored; with no array leaves at all, fall back to
+    ``block_until_ready`` (a no-op on host values)."""
+    arrays = [x for x in jax.tree.leaves(out) if isinstance(x, jax.Array)]
+    if arrays:
+        jax.device_get(min(arrays, key=lambda x: x.size))
+    else:
+        jax.block_until_ready(out)
 
 
 def run_train_iterations(step: Callable, params, tokens, targets,
@@ -31,12 +48,12 @@ def run_train_iterations(step: Callable, params, tokens, targets,
     for _ in range(warmup_iterations):
         out = step(params, tokens, targets)
     if out is not None:
-        jax.block_until_ready(out)
+        force_completion(out)
 
     start = time.perf_counter()
     for _ in range(num_iterations):
         out = step(params, tokens, targets)
-    jax.block_until_ready(out)
+    force_completion(out)
     elapsed = time.perf_counter() - start
 
     return {

@@ -42,14 +42,15 @@ def trace(log_dir: str):
 
 
 def _time_fn(fn, *args, iters: int = 5, warmup: int = 2) -> float:
+    from .metrics import force_completion  # host fetch: see metrics.py note
     out = None
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out)
+    force_completion(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    force_completion(out)
     return (time.perf_counter() - t0) / iters
 
 
@@ -82,7 +83,10 @@ def measure_bubble(cfg, mesh, sched, batch_size: int = 32,
     single_mesh = make_mesh(n_pipe=1, devices=list(mesh.devices.flat)[:1])
     single_sched = ScheduleConfig(name="GPipe",
                                   n_microbatches=sched.n_microbatches)
-    single_step = make_pipeline_step(cfg, single_mesh, single_sched)
+    # force the tick executor so the comparator pays the same remat cost as
+    # the pipeline run (the degenerate-case fast path skips remat entirely)
+    single_step = make_pipeline_step(cfg, single_mesh, single_sched,
+                                     force_tick_executor=True)
     t_single = _time_fn(single_step, params, tokens, targets, iters=iters)
 
     cs = compile_schedule(sched.name, D, sched.n_virtual, sched.n_microbatches)

@@ -71,10 +71,25 @@ def test_data_parallel_mesh(problem):
 def test_single_device_pipeline_degenerate(problem):
     params, tokens, targets, ref_loss, ref_grads = problem
     mesh = make_mesh(n_pipe=1)
+    # force_tick_executor: exercise the real 1-stage tick program (the
+    # default path lowers D=1 to plain value_and_grad, which would make this
+    # test compare the reference against itself)
+    step = make_pipeline_step(
+        CFG, mesh, dtpp.ScheduleConfig(name="GPipe", n_microbatches=4),
+        force_tick_executor=True)
+    loss, grads = step(params, tokens, targets)
+    assert_matches_reference(loss, grads, ref_loss, ref_grads)
+
+
+def test_single_device_fast_path_matches_and_checks_batch(problem):
+    params, tokens, targets, ref_loss, ref_grads = problem
+    mesh = make_mesh(n_pipe=1)
     step = make_pipeline_step(
         CFG, mesh, dtpp.ScheduleConfig(name="GPipe", n_microbatches=4))
     loss, grads = step(params, tokens, targets)
     assert_matches_reference(loss, grads, ref_loss, ref_grads)
+    with pytest.raises(AssertionError):  # batch 10 % M=4 != 0, like shard_map
+        step(params, tokens[:10], targets[:10])
 
 
 def test_stack_roundtrip():
