@@ -1,0 +1,220 @@
+"""Autoregressive decoding with a static-shape KV cache.
+
+The reference has no inference path at all (its models are randomly
+initialized, trained for throughput measurement, and discarded —
+``LLMsDistributedTrainingHelper.py:191-194``); a complete framework needs one.
+This module is TPU-first by construction:
+
+- The KV cache is a **fixed-shape** ring of ``[n_layers, B, max_len, kv_heads,
+  head_dim]`` buffers updated with ``lax.dynamic_update_slice`` — no growing
+  arrays, so the whole decode loop jits once and runs as a single XLA program.
+- Prefill and decode share one code path: ``_forward_with_cache`` processes S
+  new positions starting at a traced offset (S = prompt length for prefill,
+  S = 1 per decode step), attending each query against the full cache under a
+  position mask. One implementation, no prefill/decode drift.
+- The token loop is a ``lax.scan`` over decode steps (no Python loop, no
+  per-step dispatch); sampling (greedy / temperature / top-k / top-p) happens
+  on device.
+
+Supports the ``gpt2`` and ``llama`` block families. ``ref_decoder`` is
+rejected: the reference model is non-causal with no positional encoding
+(SURVEY.md C2), so autoregressive decoding is semantically undefined for it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import apply_rope, rope_frequencies
+from ..ops.layers import (embedding_apply, layer_norm_apply, linear_apply,
+                          rms_norm_apply)
+from ..utils.config import ModelConfig
+from .transformer import head_apply, mlp_block
+
+Pytree = Dict
+
+
+def init_cache(cfg: ModelConfig, batch_size: int, max_len: int,
+               dtype=None) -> Pytree:
+    """Allocate an all-zeros KV cache: leaves [n_layers, B, max_len, Hkv, hd]."""
+    n_kv = cfg.n_kv_heads or cfg.n_heads
+    shape = (cfg.n_layers, batch_size, max_len, n_kv, cfg.head_dim)
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _attend_cached(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                   offset: jax.Array, n_heads: int) -> jax.Array:
+    """Attention of S new queries against the full cached sequence.
+
+    q: [B, S, H, hd] at global positions offset..offset+S-1;
+    k_cache/v_cache: [B, T, Hkv, hd]. A key at cache index j is visible to the
+    query at global position i iff j <= i — which simultaneously enforces
+    causality inside the new block and masks the unwritten cache tail.
+    """
+    n_kv = k_cache.shape[2]
+    if n_kv != n_heads:  # grouped-query: repeat kv heads
+        rep = n_heads // n_kv
+        k_cache = jnp.repeat(k_cache, rep, axis=2)
+        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=q.dtype))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * scale
+    s, t = q.shape[1], k_cache.shape[1]
+    q_pos = offset + jnp.arange(s)[:, None]
+    k_pos = jnp.arange(t)[None, :]
+    scores = jnp.where((k_pos <= q_pos)[None, None], scores,
+                       jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+    return out.reshape(q.shape[0], s, -1)
+
+
+def _layer_step(cfg: ModelConfig, lp: Pytree, h: jax.Array, k_cache: jax.Array,
+                v_cache: jax.Array, offset: jax.Array,
+                rope_slice: Optional[jax.Array]
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One block over S new positions; writes their k/v into the cache at
+    ``offset`` and returns (h_out, k_cache, v_cache)."""
+    b, s, _ = h.shape
+    n_kv = cfg.n_kv_heads or cfg.n_heads
+    if cfg.arch == "gpt2":
+        a = layer_norm_apply(lp["ln1"], h)
+    else:
+        a = rms_norm_apply(lp["rms1"], h, cfg.rms_eps)
+    ap = lp["attn"]
+    q = linear_apply(ap["q"], a).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = linear_apply(ap["k"], a).reshape(b, s, n_kv, cfg.head_dim)
+    v = linear_apply(ap["v"], a).reshape(b, s, n_kv, cfg.head_dim)
+    if rope_slice is not None:
+        q = apply_rope(q, rope_slice)
+        k = apply_rope(k, rope_slice)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
+                                           (0, offset, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
+                                           (0, offset, 0, 0))
+    attn = linear_apply(ap["o"], _attend_cached(q, k_cache, v_cache, offset,
+                                                cfg.n_heads))
+    return mlp_block(cfg, lp, h + attn), k_cache, v_cache
+
+
+def _forward_with_cache(cfg: ModelConfig, params: Pytree, cache: Pytree,
+                        tokens: jax.Array, offset: jax.Array
+                        ) -> Tuple[jax.Array, Pytree]:
+    """Run S new tokens (global positions offset..offset+S-1) through the model.
+
+    Returns (last-position logits [B, V], updated cache). Serves as both
+    prefill (offset=0, S=prompt_len) and decode step (S=1).
+    """
+    if cfg.arch not in ("gpt2", "llama"):
+        raise ValueError(
+            f"generation is undefined for arch {cfg.arch!r}: the reference "
+            "block is non-causal with no positional encoding (SURVEY.md C2)")
+    b, s = tokens.shape
+    h = embedding_apply(params["embed"]["tok"], tokens)
+    if cfg.arch == "gpt2":
+        pos = jax.lax.dynamic_slice_in_dim(params["embed"]["pos"], offset, s)
+        h = h + pos
+    rope_slice = None
+    if cfg.arch == "llama":
+        angles = rope_frequencies(cfg.head_dim, cache["k"].shape[2],
+                                  cfg.rope_theta)
+        rope_slice = jax.lax.dynamic_slice_in_dim(angles, offset, s)
+
+    def body(carry, xs):
+        lp, kc, vc = xs
+        h, kc, vc = _layer_step(cfg, lp, carry, kc, vc, offset, rope_slice)
+        return h, (kc, vc)
+
+    h, (k_new, v_new) = jax.lax.scan(body, h,
+                                     (params["layers"], cache["k"], cache["v"]))
+    logits = head_apply(cfg, params["head"], h[:, -1:])[:, 0]
+    return logits, {"k": k_new, "v": v_new}
+
+
+def sample_logits(key: Optional[jax.Array], logits: jax.Array,
+                  temperature: float = 0.0, top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jax.Array:
+    """Draw next-token ids [B] from logits [B, V].
+
+    temperature=0 is greedy argmax (no key needed); otherwise categorical
+    sampling after temperature scaling, optional top-k truncation, and
+    optional top-p (nucleus) truncation.
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        cdf = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        # smallest prefix with mass >= top_p: cut at the last logit whose
+        # *preceding* cumulative mass is < top_p
+        cutoff_idx = jnp.sum(cdf - jax.nn.softmax(sorted_logits, axis=-1)
+                             < top_p, axis=-1) - 1
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def generate(cfg: ModelConfig, params: Pytree, prompt: jax.Array,
+             max_new_tokens: int, *, key: Optional[jax.Array] = None,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None,
+             max_len: Optional[int] = None) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` [B, P].
+
+    Returns [B, P + max_new_tokens]. Pure and jittable (see
+    :func:`make_generate_fn` for the pre-jitted closure); the decode loop is a
+    single ``lax.scan``.
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    max_len = max_len or total
+    if total > max_len:
+        raise ValueError(f"prompt ({p}) + max_new_tokens ({max_new_tokens}) "
+                         f"exceeds max_len ({max_len})")
+    if cfg.arch == "gpt2" and total > cfg.max_seq_len:
+        # past the learned position table, dynamic_slice would clamp and
+        # silently reuse the last position's embedding
+        raise ValueError(f"prompt ({p}) + max_new_tokens ({max_new_tokens}) "
+                         f"exceeds the gpt2 position table "
+                         f"(max_seq_len={cfg.max_seq_len})")
+    if temperature != 0.0 and key is None:
+        raise ValueError("sampling (temperature != 0) requires a PRNG key")
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = _forward_with_cache(cfg, params, cache, prompt,
+                                        jnp.int32(0))
+    keys = jax.random.split(key if key is not None else jax.random.key(0),
+                            max_new_tokens)
+    first = sample_logits(keys[0], logits, temperature, top_k, top_p)
+
+    def step(carry, step_key):
+        cache, tok, pos = carry
+        logits, cache = _forward_with_cache(cfg, params, cache, tok[:, None],
+                                            pos)
+        nxt = sample_logits(step_key, logits, temperature, top_k, top_p)
+        return (cache, nxt, pos + 1), tok
+
+    (_, last, _), toks = jax.lax.scan(step, (cache, first, jnp.int32(p)),
+                                      keys[1:])
+    new = jnp.concatenate([jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+    return jnp.concatenate([prompt, new.astype(prompt.dtype)], axis=1)
+
+
+def make_generate_fn(cfg: ModelConfig, max_new_tokens: int, *,
+                     temperature: float = 0.0, top_k: Optional[int] = None,
+                     top_p: Optional[float] = None,
+                     max_len: Optional[int] = None):
+    """Jitted (params, prompt, key) -> tokens closure over the static knobs."""
+    fn = functools.partial(generate, cfg, max_new_tokens=max_new_tokens,
+                           temperature=temperature, top_k=top_k, top_p=top_p,
+                           max_len=max_len)
+    return jax.jit(lambda params, prompt, key=None: fn(params, prompt, key=key))

@@ -82,17 +82,27 @@ def layer_apply(cfg: ModelConfig, params: Dict, h: jax.Array,
     if cfg.arch == "gpt2":
         a = layer_norm_apply(params["ln1"], h)
         h = h + mha_apply(params["attn"], a, a, cfg.n_heads, causal=cfg.causal, flash=fl)
-        m = layer_norm_apply(params["ln2"], h)
-        return h + linear_apply(params["lin2"], jax.nn.gelu(linear_apply(params["lin1"], m)))
+        return mlp_block(cfg, params, h)
     if cfg.arch == "llama":
         a = rms_norm_apply(params["rms1"], h, cfg.rms_eps)
         h = h + mha_apply(params["attn"], a, a, cfg.n_heads, causal=cfg.causal,
                           rope_angles=rope_angles, flash=fl)
-        m = rms_norm_apply(params["rms2"], h, cfg.rms_eps)
-        ff = linear_apply(params["w2"],
-                          jax.nn.silu(linear_apply(params["w1"], m)) * linear_apply(params["w3"], m))
-        return h + ff
+        return mlp_block(cfg, params, h)
     raise ValueError(f"unknown arch {cfg.arch!r}")
+
+
+def mlp_block(cfg: ModelConfig, params: Dict, h: jax.Array) -> jax.Array:
+    """Post-attention half of a gpt2/llama block (norm + MLP + residual).
+
+    Shared between the training path (:func:`layer_apply`) and the KV-cache
+    decode path (:mod:`.generate`) so the two cannot drift."""
+    if cfg.arch == "gpt2":
+        m = layer_norm_apply(params["ln2"], h)
+        return h + linear_apply(params["lin2"], jax.nn.gelu(linear_apply(params["lin1"], m)))
+    m = rms_norm_apply(params["rms2"], h, cfg.rms_eps)
+    ff = linear_apply(params["w2"],
+                      jax.nn.silu(linear_apply(params["w1"], m)) * linear_apply(params["w3"], m))
+    return h + ff
 
 
 # ---------------------------------------------------------------------------
