@@ -29,7 +29,8 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import apply_rope, rope_frequencies
+from ..ops.attention import (apply_rope, gqa_expand, rope_frequencies,
+                             scaled_dot_attention)
 from ..ops.layers import (embedding_apply, layer_norm_apply, linear_apply,
                           rms_norm_apply)
 from ..utils.config import ModelConfig
@@ -56,20 +57,11 @@ def _attend_cached(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     query at global position i iff j <= i — which simultaneously enforces
     causality inside the new block and masks the unwritten cache tail.
     """
-    n_kv = k_cache.shape[2]
-    if n_kv != n_heads:  # grouped-query: repeat kv heads
-        rep = n_heads // n_kv
-        k_cache = jnp.repeat(k_cache, rep, axis=2)
-        v_cache = jnp.repeat(v_cache, rep, axis=2)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=q.dtype))
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * scale
+    k_cache, v_cache = gqa_expand(k_cache, v_cache, n_heads)
     s, t = q.shape[1], k_cache.shape[1]
     q_pos = offset + jnp.arange(s)[:, None]
     k_pos = jnp.arange(t)[None, :]
-    scores = jnp.where((k_pos <= q_pos)[None, None], scores,
-                       jnp.finfo(scores.dtype).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+    out = scaled_dot_attention(q, k_cache, v_cache, (k_pos <= q_pos)[None, None])
     return out.reshape(q.shape[0], s, -1)
 
 
@@ -148,15 +140,16 @@ def sample_logits(key: Optional[jax.Array], logits: jax.Array,
         return jnp.argmax(logits, axis=-1)
     logits = logits.astype(jnp.float32) / temperature
     if top_k is not None:
+        top_k = min(top_k, logits.shape[-1])
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None:
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        cdf = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
         # smallest prefix with mass >= top_p: cut at the last logit whose
-        # *preceding* cumulative mass is < top_p
-        cutoff_idx = jnp.sum(cdf - jax.nn.softmax(sorted_logits, axis=-1)
-                             < top_p, axis=-1) - 1
+        # *preceding* (exclusive) cumulative mass is < top_p
+        exclusive_cdf = jnp.cumsum(probs, axis=-1) - probs
+        cutoff_idx = jnp.sum(exclusive_cdf < top_p, axis=-1) - 1
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1)

@@ -1,0 +1,177 @@
+"""Import Hugging Face GPT-2 / Llama checkpoints into this framework.
+
+The reference trains randomly initialized models and discards them
+(``LLMsDistributedTrainingHelper.py:191-194``, SURVEY.md §5 checkpoint row:
+"models are randomly initialized per experiment"); real-model runs need
+parameter *loading*. This module converts ``transformers`` checkpoints
+(``GPT2LMHeadModel``, ``LlamaForCausalLM``) — or their raw state dicts — into
+this framework's stacked-layer pytrees, so pretrained weights flow straight
+into the pipeline/TP/FSDP shardings.
+
+Convention notes (why the conversion is exact, verified to ~1e-4 in
+``tests/test_hf_import.py``):
+
+- HF GPT-2 ``Conv1D`` stores weights as ``[in, out]`` — already this
+  framework's linear layout; torch ``nn.Linear`` (Llama) stores ``[out, in]``
+  and is transposed.
+- HF GPT-2's ``gelu_new`` is the tanh approximation == ``jax.nn.gelu``'s
+  default; LayerNorm eps 1e-5 matches :func:`..ops.layers.layer_norm_apply`.
+- HF Llama RoPE is the half-split ("rotate_half") convention — identical to
+  :func:`..ops.attention.apply_rope`; rms eps is carried through the config.
+- GPT-2 ties ``lm_head`` to ``wte``; the tied matrix is materialized as
+  ``head.out.w`` (this framework keeps an explicit output head so stage
+  slicing stays uniform, SURVEY.md C3).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.config import ModelConfig
+
+Pytree = Dict
+
+
+def _np(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    return t.detach().cpu().numpy()  # torch tensor
+
+
+def _state_dict(model_or_sd) -> Dict[str, np.ndarray]:
+    sd = model_or_sd if isinstance(model_or_sd, dict) else model_or_sd.state_dict()
+    return {k: _np(v) for k, v in sd.items()}
+
+
+def _stack(layer_dicts):
+    """[{leaf: arr}] per layer -> {leaf: arr stacked on axis 0} (the stacked
+    layer layout of :func:`..models.transformer.transformer_init`)."""
+    import jax
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layer_dicts)
+
+
+# ---------------------------------------------------------------------------
+# GPT-2
+# ---------------------------------------------------------------------------
+
+
+def gpt2_config_from_hf(hf_config) -> ModelConfig:
+    return ModelConfig(
+        dim=hf_config.n_embd, n_layers=hf_config.n_layer,
+        n_heads=hf_config.n_head, vocab_size=hf_config.vocab_size,
+        ffn_dim=hf_config.n_inner or 4 * hf_config.n_embd,
+        max_seq_len=hf_config.n_positions, arch="gpt2")
+
+
+def gpt2_params_from_hf(model_or_sd, cfg: ModelConfig) -> Pytree:
+    sd = _state_dict(model_or_sd)
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    d = cfg.dim
+
+    def layer(i):
+        p = f"{pre}h.{i}."
+        ca_w, ca_b = sd[p + "attn.c_attn.weight"], sd[p + "attn.c_attn.bias"]
+        return {
+            "ln1": {"scale": sd[p + "ln_1.weight"], "bias": sd[p + "ln_1.bias"]},
+            "attn": {
+                "q": {"w": ca_w[:, :d], "b": ca_b[:d]},
+                "k": {"w": ca_w[:, d:2 * d], "b": ca_b[d:2 * d]},
+                "v": {"w": ca_w[:, 2 * d:], "b": ca_b[2 * d:]},
+                "o": {"w": sd[p + "attn.c_proj.weight"],
+                      "b": sd[p + "attn.c_proj.bias"]},
+            },
+            "ln2": {"scale": sd[p + "ln_2.weight"], "bias": sd[p + "ln_2.bias"]},
+            "lin1": {"w": sd[p + "mlp.c_fc.weight"], "b": sd[p + "mlp.c_fc.bias"]},
+            "lin2": {"w": sd[p + "mlp.c_proj.weight"], "b": sd[p + "mlp.c_proj.bias"]},
+        }
+
+    wte = sd[pre + "wte.weight"]
+    params = {
+        "embed": {"tok": wte, "pos": sd[pre + "wpe.weight"][:cfg.max_seq_len]},
+        "layers": _stack([layer(i) for i in range(cfg.n_layers)]),
+        "head": {"norm": {"scale": sd[pre + "ln_f.weight"],
+                          "bias": sd[pre + "ln_f.bias"]},
+                 "out": {"w": sd.get("lm_head.weight", wte).T}},  # tied head
+    }
+    return _to_dtype(params, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Llama
+# ---------------------------------------------------------------------------
+
+
+def llama_config_from_hf(hf_config) -> ModelConfig:
+    return ModelConfig(
+        dim=hf_config.hidden_size, n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        vocab_size=hf_config.vocab_size, ffn_dim=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings, arch="llama",
+        rope_theta=float(hf_config.rope_theta),
+        rms_eps=float(hf_config.rms_norm_eps))
+
+
+def llama_params_from_hf(model_or_sd, cfg: ModelConfig) -> Pytree:
+    sd = _state_dict(model_or_sd)
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+
+    def lin_t(name):  # torch nn.Linear [out, in] -> [in, out], no bias
+        return {"w": sd[name].T}
+
+    def layer(i):
+        p = f"{pre}layers.{i}."
+        return {
+            "rms1": {"scale": sd[p + "input_layernorm.weight"]},
+            "attn": {"q": lin_t(p + "self_attn.q_proj.weight"),
+                     "k": lin_t(p + "self_attn.k_proj.weight"),
+                     "v": lin_t(p + "self_attn.v_proj.weight"),
+                     "o": lin_t(p + "self_attn.o_proj.weight")},
+            "rms2": {"scale": sd[p + "post_attention_layernorm.weight"]},
+            "w1": lin_t(p + "mlp.gate_proj.weight"),
+            "w2": lin_t(p + "mlp.down_proj.weight"),
+            "w3": lin_t(p + "mlp.up_proj.weight"),
+        }
+
+    embed = sd[pre + "embed_tokens.weight"]
+    params = {
+        "embed": {"tok": embed},
+        "layers": _stack([layer(i) for i in range(cfg.n_layers)]),
+        "head": {"norm": {"scale": sd[pre + "norm.weight"]},
+                 "out": {"w": sd["lm_head.weight"].T if "lm_head.weight" in sd
+                         else embed.T}},  # tied head (llama3.2-class)
+    }
+    return _to_dtype(params, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+
+def _to_dtype(params: Pytree, cfg: ModelConfig) -> Pytree:
+    import jax
+    dtype = jnp.dtype(cfg.dtype)
+    return jax.tree.map(lambda x: jnp.asarray(x, dtype), params)
+
+
+def from_hf(model, dtype: str = "float32") -> Tuple[ModelConfig, Pytree]:
+    """Convert a ``transformers`` causal-LM model to (ModelConfig, params).
+
+    Dispatches on the HF config's ``model_type`` ("gpt2" or "llama").
+    """
+    mt = model.config.model_type
+    if mt == "gpt2":
+        cfg = gpt2_config_from_hf(model.config)
+        import dataclasses
+        cfg = dataclasses.replace(cfg, dtype=dtype)
+        return cfg, gpt2_params_from_hf(model, cfg)
+    if mt == "llama":
+        cfg = llama_config_from_hf(model.config)
+        import dataclasses
+        cfg = dataclasses.replace(cfg, dtype=dtype)
+        return cfg, llama_params_from_hf(model, cfg)
+    raise ValueError(f"unsupported HF model_type {mt!r}; expected gpt2 or llama")

@@ -54,6 +54,33 @@ def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
     return out.astype(x.dtype)  # rotation runs in f32; don't promote bf16 activations
 
 
+def gqa_expand(k: jax.Array, v: jax.Array, n_heads: int):
+    """Repeat kv heads up to n_heads for grouped-query attention (no-op for MHA)."""
+    n_kv = k.shape[2]
+    if n_kv != n_heads:
+        rep = n_heads // n_kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+def scaled_dot_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         mask: Optional[jax.Array] = None) -> jax.Array:
+    """Core attention: q [b,s,h,d] x k/v [b,t,h,d] -> [b,s,h,d].
+
+    ``mask`` broadcasts against scores [b,h,s,t]; False positions are dropped.
+    Shared by the training path (:func:`mha_apply`) and the KV-cache decode
+    path (:mod:`..models.generate`) so the two cannot drift. Softmax runs in
+    f32 regardless of activation dtype.
+    """
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=q.dtype))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
               causal: bool = False, rope_angles: Optional[jax.Array] = None,
               flash: bool = False) -> jax.Array:
@@ -70,21 +97,15 @@ def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
     if rope_angles is not None:
         q = apply_rope(q, rope_angles)
         k = apply_rope(k, rope_angles)
-    if n_kv != n_heads:  # grouped-query: repeat kv heads
-        rep = n_heads // n_kv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    k, v = gqa_expand(k, v, n_heads)
     if flash:
         from .pallas_attention import flash_attention
         out = flash_attention(q, k, v, causal=causal)
     else:
-        scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=q.dtype))
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        mask = None
         if causal:
             s = q_in.shape[1]
-            mask = jnp.tril(jnp.ones((s, s), dtype=bool))
-            scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None]
+        out = scaled_dot_attention(q, k, v, mask)
     out = out.reshape(q_in.shape[0], q_in.shape[1], -1)
     return linear_apply(params["o"], out)
