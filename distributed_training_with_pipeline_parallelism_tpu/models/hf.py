@@ -105,6 +105,18 @@ def gpt2_params_from_hf(model_or_sd, cfg: ModelConfig) -> Pytree:
 
 
 def llama_config_from_hf(hf_config) -> ModelConfig:
+    # Refuse configs whose semantics this conversion does not carry — a
+    # silent pass-through here would produce plausible-looking wrong logits.
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        raise NotImplementedError(
+            f"rope_scaling={scaling!r} (Llama-3.1+ long-context NTK/llama3 "
+            f"frequency scaling) is not supported by this converter; only "
+            f"plain RoPE with rope_theta is")
+    if getattr(hf_config, "attention_bias", False):
+        raise NotImplementedError(
+            "attention_bias=True checkpoints are not supported (projection "
+            "biases would be dropped)")
     return ModelConfig(
         dim=hf_config.hidden_size, n_layers=hf_config.num_hidden_layers,
         n_heads=hf_config.num_attention_heads,
@@ -158,20 +170,23 @@ def _to_dtype(params: Pytree, cfg: ModelConfig) -> Pytree:
     return jax.tree.map(lambda x: jnp.asarray(x, dtype), params)
 
 
+_CONVERTERS = {
+    "gpt2": (gpt2_config_from_hf, gpt2_params_from_hf),
+    "llama": (llama_config_from_hf, llama_params_from_hf),
+}
+
+
 def from_hf(model, dtype: str = "float32") -> Tuple[ModelConfig, Pytree]:
     """Convert a ``transformers`` causal-LM model to (ModelConfig, params).
 
     Dispatches on the HF config's ``model_type`` ("gpt2" or "llama").
     """
+    import dataclasses
+
     mt = model.config.model_type
-    if mt == "gpt2":
-        cfg = gpt2_config_from_hf(model.config)
-        import dataclasses
-        cfg = dataclasses.replace(cfg, dtype=dtype)
-        return cfg, gpt2_params_from_hf(model, cfg)
-    if mt == "llama":
-        cfg = llama_config_from_hf(model.config)
-        import dataclasses
-        cfg = dataclasses.replace(cfg, dtype=dtype)
-        return cfg, llama_params_from_hf(model, cfg)
-    raise ValueError(f"unsupported HF model_type {mt!r}; expected gpt2 or llama")
+    if mt not in _CONVERTERS:
+        raise ValueError(
+            f"unsupported HF model_type {mt!r}; expected {sorted(_CONVERTERS)}")
+    config_fn, params_fn = _CONVERTERS[mt]
+    cfg = dataclasses.replace(config_fn(model.config), dtype=dtype)
+    return cfg, params_fn(model, cfg)

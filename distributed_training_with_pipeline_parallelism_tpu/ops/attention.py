@@ -81,14 +81,14 @@ def scaled_dot_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
-              causal: bool = False, rope_angles: Optional[jax.Array] = None,
-              flash: bool = False) -> jax.Array:
-    """Attention: queries from ``q_in``, keys/values from ``kv_in`` (both [b, s, d]).
-
-    ``flash=True`` routes the core attention through the fused Pallas kernel
-    (:mod:`.pallas_attention`) instead of dense XLA softmax-matmuls.
-    """
+def qkv_project(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
+                rope_angles: Optional[jax.Array] = None,
+                expand_gqa: bool = True):
+    """Shared attention prologue: linear q/k/v projections, head split,
+    optional RoPE, optional GQA expansion. Used by the dense path
+    (:func:`mha_apply`) and both sequence-parallel wrappers
+    (``parallel.ring_attention`` / ``parallel.ulysses``) so the projection
+    conventions cannot drift between them."""
     head_dim = params["q"]["w"].shape[1] // n_heads
     n_kv = params["k"]["w"].shape[1] // head_dim
     q = _split_heads(linear_apply(params["q"], q_in), n_heads)
@@ -97,7 +97,20 @@ def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
     if rope_angles is not None:
         q = apply_rope(q, rope_angles)
         k = apply_rope(k, rope_angles)
-    k, v = gqa_expand(k, v, n_heads)
+    if expand_gqa:
+        k, v = gqa_expand(k, v, n_heads)
+    return q, k, v
+
+
+def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
+              causal: bool = False, rope_angles: Optional[jax.Array] = None,
+              flash: bool = False) -> jax.Array:
+    """Attention: queries from ``q_in``, keys/values from ``kv_in`` (both [b, s, d]).
+
+    ``flash=True`` routes the core attention through the fused Pallas kernel
+    (:mod:`.pallas_attention`) instead of dense XLA softmax-matmuls.
+    """
+    q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles)
     if flash:
         from .pallas_attention import flash_attention
         out = flash_attention(q, k, v, causal=causal)

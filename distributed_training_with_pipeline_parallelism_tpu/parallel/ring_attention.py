@@ -25,7 +25,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import apply_rope, rope_frequencies
+from ..ops.attention import qkv_project, rope_frequencies
 from ..ops.layers import linear_apply
 
 NEG_INF = -1e30
@@ -93,23 +93,8 @@ def ring_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
     ``rope_angles`` must already be sliced to this device's global positions
     (see :func:`local_rope_angles`).
     """
-    head_dim = params["q"]["w"].shape[1] // n_heads
-    n_kv = params["k"]["w"].shape[1] // head_dim
     b, s, _ = q_in.shape
-
-    def split(x, n):
-        return x.reshape(b, -1, n, head_dim)
-
-    q = split(linear_apply(params["q"], q_in), n_heads)
-    k = split(linear_apply(params["k"], kv_in), n_kv)
-    v = split(linear_apply(params["v"], kv_in), n_kv)
-    if rope_angles is not None:
-        q = apply_rope(q, rope_angles)
-        k = apply_rope(k, rope_angles)
-    if n_kv != n_heads:
-        rep = n_heads // n_kv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles)
     out = ring_attention(q, k, v, axis_name, causal=causal)
     return linear_apply(params["o"], out.reshape(b, s, -1))
 

@@ -1,9 +1,12 @@
-"""Sequence-parallel training path: full model with ring attention.
+"""Sequence-parallel training path: full model over a 'seq' mesh axis.
 
 Shards the *sequence* dimension of activations over a 'seq' mesh axis —
-embeddings, LayerNorms and MLPs are position-wise (purely local), and
-attention runs over the ICI ring (:mod:`.ring_attention`). Loss and grads
-are exact: identical to the unsharded model up to float associativity.
+embeddings, LayerNorms and MLPs are position-wise (purely local), and the
+attention core runs under one of two strategies, selected by ``attn_impl``:
+``"ring"`` (K/V ppermute ring, :mod:`.ring_attention`) or ``"ulysses"``
+(head-scatter/seq-gather all-to-all, :mod:`.ulysses`). Loss and grads are
+exact either way: identical to the unsharded model up to float
+associativity.
 
 This is the long-context scaling story the reference lacks entirely
 (SURVEY.md §5: fixed seq 128, no sequence parallelism of any kind). It
@@ -25,34 +28,38 @@ from ..ops.layers import (cross_entropy_loss, embedding_apply,
 from .mesh import SEQ_AXIS
 from .pipeline import _shard_map
 from .ring_attention import local_rope_angles, ring_mha_apply
+from .ulysses import ulysses_mha_apply
 
 Pytree = Any
 
+ATTN_IMPLS = {"ring": ring_mha_apply, "ulysses": ulysses_mha_apply}
+
 
 def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
-                   rope_angles) -> jax.Array:
+                   rope_angles, attn_impl: str = "ring") -> jax.Array:
     """Sequence-sharded twin of ``models.transformer.layer_apply``."""
+    sp_mha = ATTN_IMPLS[attn_impl]
     if cfg.arch == "ref_decoder":
         mem = h
         x = layer_norm_apply(params["ln1"],
-                             h + ring_mha_apply(params["self_attn"], h, h,
-                                                cfg.n_heads, axis_name))
+                             h + sp_mha(params["self_attn"], h, h,
+                                        cfg.n_heads, axis_name))
         x = layer_norm_apply(params["ln2"],
-                             x + ring_mha_apply(params["cross_attn"], x, mem,
-                                                cfg.n_heads, axis_name))
+                             x + sp_mha(params["cross_attn"], x, mem,
+                                        cfg.n_heads, axis_name))
         ff = linear_apply(params["lin2"], jax.nn.relu(linear_apply(params["lin1"], x)))
         return layer_norm_apply(params["ln3"], x + ff)
     if cfg.arch == "gpt2":
         a = layer_norm_apply(params["ln1"], h)
-        h = h + ring_mha_apply(params["attn"], a, a, cfg.n_heads, axis_name,
-                               causal=True)
+        h = h + sp_mha(params["attn"], a, a, cfg.n_heads, axis_name,
+                       causal=True)
         m = layer_norm_apply(params["ln2"], h)
         return h + linear_apply(params["lin2"],
                                 jax.nn.gelu(linear_apply(params["lin1"], m)))
     if cfg.arch == "llama":
         a = rms_norm_apply(params["rms1"], h, cfg.rms_eps)
-        h = h + ring_mha_apply(params["attn"], a, a, cfg.n_heads, axis_name,
-                               causal=True, rope_angles=rope_angles)
+        h = h + sp_mha(params["attn"], a, a, cfg.n_heads, axis_name,
+                       causal=True, rope_angles=rope_angles)
         m = rms_norm_apply(params["rms2"], h, cfg.rms_eps)
         ff = linear_apply(params["w2"],
                           jax.nn.silu(linear_apply(params["w1"], m))
@@ -61,11 +68,18 @@ def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
     raise ValueError(f"unknown arch {cfg.arch!r}")
 
 
-def make_sp_loss_fn(cfg: ModelConfig, mesh: Mesh,
+def make_sp_loss_fn(cfg: ModelConfig, mesh: Mesh, attn_impl: str = "ring",
                     ) -> Callable[[Pytree, jax.Array, jax.Array], jax.Array]:
     """Sequence-parallel loss: ``(params, tokens, targets) -> scalar``.
     Differentiable — wrap in ``jax.value_and_grad`` (+jit) for training;
-    shard_map's transpose rules turn the forward ring into a backward ring."""
+    shard_map's transpose rules turn the forward collectives into the
+    matching backward collectives (reverse ring / inverse all-to-all).
+
+    ``attn_impl``: ``"ring"`` (no cap on the parallel degree) or
+    ``"ulysses"`` (requires ``n_heads % axis size == 0``)."""
+    if attn_impl not in ATTN_IMPLS:
+        raise ValueError(f"attn_impl must be one of {sorted(ATTN_IMPLS)}, "
+                         f"got {attn_impl!r}")
     D = mesh.shape[SEQ_AXIS]
 
     def spmd_loss(params, tokens, targets):
@@ -82,7 +96,8 @@ def make_sp_loss_fn(cfg: ModelConfig, mesh: Mesh,
                 if cfg.arch == "llama" else None)
 
         def step(carry, layer_params):
-            return sp_layer_apply(cfg, layer_params, carry, SEQ_AXIS, rope), None
+            return sp_layer_apply(cfg, layer_params, carry, SEQ_AXIS, rope,
+                                  attn_impl=attn_impl), None
 
         h, _ = jax.lax.scan(step, h, params["layers"])
         if cfg.arch == "llama":

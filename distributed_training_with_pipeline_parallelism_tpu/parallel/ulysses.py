@@ -1,0 +1,87 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second classic long-context strategy (alongside ring attention,
+:mod:`.ring_attention`) — the reference has neither (SURVEY.md §5
+long-context row). After DeepSpeed-Ulysses (arXiv:2309.14509): activations
+stay sequence-sharded ``[B, S/D, dim]`` through all position-wise compute;
+around the attention core two ``jax.lax.all_to_all`` collectives re-shard
+from sequence-split to *head*-split — each device then holds the FULL
+sequence for ``H/D`` of the heads, runs ordinary dense attention on it, and
+the inverse all-to-all restores sequence sharding.
+
+Trade-offs vs the ring (why a complete framework carries both):
+
+- Ulysses moves ``O(S * dim / D)`` bytes per device in two fused
+  all-to-alls (great on ICI tori, where all-to-all bisection is high) and
+  keeps the attention core a single large MXU-friendly matmul; the ring
+  issues ``D`` ppermute hops but never materializes full-sequence scores.
+- Ulysses caps the parallel degree at the head count (``H % D == 0``); the
+  ring has no such cap (useful for GQA models with few KV heads).
+
+Gradients come from plain ``jax.grad``: ``all_to_all`` is its own transpose
+(with split/concat axes swapped), so the backward pass is also two
+all-to-alls — no custom VJP needed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import gqa_expand, qkv_project, scaled_dot_attention
+from ..ops.layers import linear_apply
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, causal: bool = False) -> jax.Array:
+    """Exact attention with the sequence sharded over ``axis_name``.
+
+    q, k, v: [batch, seq_local, heads, head_dim] per-device shards. Q heads
+    must divide by the axis size; K/V may carry fewer (GQA) heads — when
+    those also divide by the axis size they are all-to-all'd *unexpanded*
+    (saving n_heads/n_kv_heads of the K/V communication volume) and expanded
+    after the gather, otherwise they are expanded up front. Returns the local
+    query chunk's attention output, identical to unsharded attention up to
+    float associativity.
+    """
+    D = jax.lax.psum(1, axis_name)
+    h, h_kv = q.shape[2], k.shape[2]
+    if h % D != 0:
+        raise ValueError(f"Ulysses needs n_heads % axis size == 0 ({h} % {D})")
+    if h_kv % D != 0:  # too few KV heads to split: expand before the scatter
+        k, v = gqa_expand(k, v, h)
+
+    def scatter_heads(x):  # [b, s/D, h, dh] -> [b, s, h/D, dh]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    q, k, v = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    k, v = gqa_expand(k, v, q.shape[2])  # no-op if already expanded
+    mask = None
+    if causal:
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None]
+    out = scaled_dot_attention(q, k, v, mask)
+    # [b, s, h/D, dh] -> [b, s/D, h, dh]
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ulysses_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
+                      n_heads: int, axis_name: str, causal: bool = False,
+                      rope_angles: Optional[jax.Array] = None) -> jax.Array:
+    """Sequence-parallel drop-in for ``ops.attention.mha_apply`` (same
+    signature as :func:`..ring_attention.ring_mha_apply`): projections are
+    position-wise (local); the attention core re-shards via all-to-all.
+
+    ``rope_angles`` must be pre-sliced to this device's global positions
+    (``ring_attention.local_rope_angles``) — rotation happens *before* the
+    head-scatter, while rows still sit at their global positions.
+    """
+    b, s, _ = q_in.shape
+    q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles,
+                          expand_gqa=False)  # expansion happens post-gather
+    out = ulysses_attention(q, k, v, axis_name, causal=causal)
+    return linear_apply(params["o"], out.reshape(b, s, -1))

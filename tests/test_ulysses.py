@@ -1,0 +1,115 @@
+"""Ulysses all-to-all sequence parallelism: exactness vs unsharded attention."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.models import transformer as tfm
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+    SEQ_AXIS, make_sp_mesh)
+from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import _shard_map
+from distributed_training_with_pipeline_parallelism_tpu.parallel.seq_parallel import (
+    make_sp_loss_fn)
+from distributed_training_with_pipeline_parallelism_tpu.parallel.ulysses import (
+    ulysses_attention)
+
+
+def _full_attention(q, k, v, causal):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        n = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((n, n), bool))[None, None], s,
+                      jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(causal):
+    D = 4
+    b, s, h, dh = 2, 32, 8, 16  # h % D == 0 (Ulysses head-split requirement)
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, dh))
+    k = jax.random.normal(ks[1], (b, s, h, dh))
+    v = jax.random.normal(ks[2], (b, s, h, dh))
+    ref = _full_attention(q, k, v, causal)
+
+    mesh = make_sp_mesh(D)
+    uly = _shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, SEQ_AXIS, causal=causal),
+        mesh,
+        in_specs=(P(None, SEQ_AXIS),) * 3, out_specs=P(None, SEQ_AXIS))
+    got = uly(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_attention_grads_match():
+    D = 4
+    b, s, h, dh = 1, 16, 4, 8
+    ks = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(ks[0], (b, s, h, dh))
+    k = jax.random.normal(ks[1], (b, s, h, dh))
+    v = jax.random.normal(ks[2], (b, s, h, dh))
+    mesh = make_sp_mesh(D)
+    uly = _shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, SEQ_AXIS, causal=True),
+        mesh,
+        in_specs=(P(None, SEQ_AXIS),) * 3, out_specs=P(None, SEQ_AXIS))
+    g_uly = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(uly(q, k, v))),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(jnp.sin(_full_attention(q, k, v, True))),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_uly, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("arch,kw", [
+    ("ref_decoder", {}),
+    ("gpt2", {}),
+    ("llama", dict(n_kv_heads=2)),  # GQA: heads expand before the all-to-all
+])
+def test_ulysses_seq_parallel_loss_and_grads_match(arch, kw):
+    cfg = dtpp.ModelConfig(dim=32, n_layers=2, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=64, arch=arch, **kw)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (2, 32), 0, cfg.vocab_size)
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(cfg, p, tokens, targets))(params)
+
+    mesh = make_sp_mesh(4)
+    sp_loss_fn = make_sp_loss_fn(cfg, mesh, attn_impl="ulysses")
+    loss, grads = jax.jit(jax.value_and_grad(
+        lambda p: sp_loss_fn(p, tokens, targets)))(params)
+
+    assert float(jnp.abs(loss - ref_loss)) < 1e-5
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                       grads, ref_grads)
+    assert max(jax.tree.leaves(err)) < 2e-5
+
+
+def test_ulysses_rejects_indivisible_heads():
+    cfg = dtpp.ModelConfig(dim=24, n_layers=1, n_heads=3, vocab_size=64,
+                           ffn_dim=48, max_seq_len=64, arch="gpt2")
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
+    mesh = make_sp_mesh(4)
+    fn = make_sp_loss_fn(cfg, mesh, attn_impl="ulysses")
+    with pytest.raises(ValueError, match="n_heads"):
+        jax.jit(fn)(params, tokens, tokens)
+
+
+def test_unknown_attn_impl_rejected():
+    cfg = dtpp.ModelConfig(dim=32, n_layers=1, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=64, arch="gpt2")
+    with pytest.raises(ValueError, match="attn_impl"):
+        make_sp_loss_fn(cfg, make_sp_mesh(4), attn_impl="nope")
