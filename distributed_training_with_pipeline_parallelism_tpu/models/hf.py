@@ -108,7 +108,9 @@ def llama_config_from_hf(hf_config) -> ModelConfig:
     # Refuse configs whose semantics this conversion does not carry — a
     # silent pass-through here would produce plausible-looking wrong logits.
     scaling = getattr(hf_config, "rope_scaling", None)
-    if scaling:
+    # An explicit {'rope_type': 'default'} dict is transformers' spelling of
+    # plain RoPE (equivalent to rope_scaling=None) — allow it through.
+    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
         raise NotImplementedError(
             f"rope_scaling={scaling!r} (Llama-3.1+ long-context NTK/llama3 "
             f"frequency scaling) is not supported by this converter; only "
@@ -117,6 +119,10 @@ def llama_config_from_hf(hf_config) -> ModelConfig:
         raise NotImplementedError(
             "attention_bias=True checkpoints are not supported (projection "
             "biases would be dropped)")
+    if getattr(hf_config, "mlp_bias", False):
+        raise NotImplementedError(
+            "mlp_bias=True checkpoints are not supported (gate/up/down "
+            "projection biases would be dropped)")
     return ModelConfig(
         dim=hf_config.hidden_size, n_layers=hf_config.num_hidden_layers,
         n_heads=hf_config.num_attention_heads,

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import mha_apply, mha_init
-from ..ops.layers import (cross_entropy_loss, embedding_apply, embedding_init,
+from ..ops.layers import (select_xent, embedding_apply, embedding_init,
                           layer_norm_apply, layer_norm_init, linear_apply,
                           linear_init)
 from ..utils.config import ModelConfig
@@ -238,7 +238,7 @@ def moe_lm_loss(cfg: ModelConfig, moe: MoEConfig, params: Dict,
                                params["layers"])
     logits = linear_apply(params["head"]["out"],
                           layer_norm_apply(params["head"]["norm"], h))
-    loss = (cross_entropy_loss(logits, targets)
+    loss = (select_xent(cfg.use_fused_xent)(logits, targets)
             + moe.aux_loss_weight * aux / cfg.n_layers)
     if axis_name is not None:
         loss = jax.lax.psum(loss, axis_name) / jax.lax.psum(1, axis_name)

@@ -28,9 +28,10 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import mha_apply, mha_init, rope_frequencies
-from ..ops.layers import (cross_entropy_loss, embedding_apply, embedding_init,
+from ..ops.layers import (embedding_apply, embedding_init,
                           layer_norm_apply, layer_norm_init, linear_apply,
-                          linear_init, rms_norm_apply, rms_norm_init)
+                          linear_init, rms_norm_apply, rms_norm_init,
+                          select_xent)
 from ..utils.config import ModelConfig
 
 # ---------------------------------------------------------------------------
@@ -178,4 +179,5 @@ def transformer_loss(cfg: ModelConfig, params: Dict, tokens: jax.Array,
     """Single-device reference loss — the ground truth the pipeline executors
     are verified against (a check the reference itself never performs,
     SURVEY.md §4)."""
-    return cross_entropy_loss(transformer_apply(cfg, params, tokens), targets)
+    return select_xent(cfg.use_fused_xent)(
+        transformer_apply(cfg, params, tokens), targets)

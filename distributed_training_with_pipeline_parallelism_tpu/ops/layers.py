@@ -70,3 +70,13 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logz, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)
+
+
+def select_xent(use_fused: bool):
+    """Pick the loss implementation: the XLA formulation above, or the Pallas
+    fused kernel (``ops.pallas_xent``) which never materializes the [N, V]
+    log-softmax. Both compute identical values (tested)."""
+    if use_fused:
+        from .pallas_xent import fused_cross_entropy_loss
+        return fused_cross_entropy_loss
+    return cross_entropy_loss

@@ -1,0 +1,131 @@
+"""Fused softmax-cross-entropy kernel in Pallas (Mosaic) for TPU.
+
+The XLA path (``ops.layers.cross_entropy_loss``, matching the reference's
+``tokenwise_loss_fn`` at ``LLMsDistributedTrainingHelper.py:197-201``) computes
+``log_softmax`` over the full ``[B*S, V]`` logits in float32 before gathering
+the target column — at GPT-2 scale (B*S=4096, V=50257) that intermediate is
+~0.8 GB of HBM traffic per step. This kernel computes the per-row
+``logsumexp`` and target logit in VMEM tiles, so only the ``[N]``-shaped
+``nll`` / ``lse`` vectors ever reach HBM on the forward.
+
+Backward (``jax.custom_vjp``): with the saved ``lse`` the gradient is a pure
+elementwise function of the logits — ``(exp(x - lse) - onehot) * g`` — which
+XLA fuses into a single read-logits / write-grad pass; no extra intermediate
+is materialized.
+
+Layout: grid is ``(N // block_n,)``; each instance holds a
+``[block_n, V]`` row tile in VMEM. ``block_n`` adapts to the vocab so the
+tile stays under the VMEM budget. Rows must divide evenly (true for every
+batch*seq in the sweep); otherwise the caller falls back to the XLA path.
+On non-TPU backends the kernel runs in interpreter mode so CPU CI exercises
+the same code path (same convention as ``ops.pallas_attention``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .pallas_attention import NEG_INF, _use_interpret
+
+_VMEM_TILE_BYTES = 4 * 1024 * 1024  # fp32 row-tile budget per kernel instance
+
+
+def _pick_block_n(n_rows: int, vocab: int) -> int:
+    """Largest power-of-two row count that divides ``n_rows`` and keeps the
+    fp32 ``[block_n, V]`` tile within the VMEM budget."""
+    cap = max(1, _VMEM_TILE_BYTES // (4 * vocab))
+    bn = 1
+    while bn * 2 <= min(cap, 128) and n_rows % (bn * 2) == 0:
+        bn *= 2
+    return bn
+
+
+def _xent_fwd_kernel(logits_ref, targets_ref, nll_ref, lse_ref, *, vocab: int):
+    x = logits_ref[...].astype(jnp.float32)  # [block_n, V]
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    x = jnp.where(cols < vocab, x, NEG_INF)  # mask any lane padding
+    m = jnp.max(x, axis=1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m[:, None]), axis=1))
+    tgt = targets_ref[...][:, 0]  # [block_n]
+    tl = jnp.sum(jnp.where(cols == tgt[:, None], x, 0.0), axis=1)
+    nll_ref[...] = (lse - tl)[:, None]
+    lse_ref[...] = lse[:, None]
+
+
+def _xent_fwd_pallas(logits: jax.Array, targets: jax.Array):
+    """logits [N, V], targets [N] int -> (nll [N] f32, lse [N] f32)."""
+    n, v = logits.shape
+    block_n = _pick_block_n(n, v)
+    out = pl.pallas_call(
+        functools.partial(_xent_fwd_kernel, vocab=v),
+        out_shape=(jax.ShapeDtypeStruct((n, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((n, 1), jnp.float32)),
+        grid=(n // block_n,),
+        in_specs=[
+            pl.BlockSpec((block_n, v), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+        ],
+        out_specs=(pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((block_n, 1), lambda i: (i, 0))),
+        interpret=_use_interpret(),
+    )(logits, targets.astype(jnp.int32)[:, None])
+    nll, lse = out
+    return nll[:, 0], lse[:, 0]
+
+
+@jax.custom_vjp
+def _xent(logits, targets):
+    nll, _ = _xent_fwd_pallas(logits, targets)
+    return nll
+
+
+def _xent_vjp_fwd(logits, targets):
+    nll, lse = _xent_fwd_pallas(logits, targets)
+    return nll, (logits, targets, lse)
+
+
+def _xent_vjp_bwd(res, g):
+    logits, targets, lse = res
+    # d nll_i / d x_ij = softmax(x)_ij - onehot(t_i)_j ; fused by XLA into one
+    # read-logits/write-grad pass (p is a fusion intermediate, not an array).
+    p = jnp.exp(logits.astype(jnp.float32) - lse[:, None])
+    cols = jnp.arange(logits.shape[-1], dtype=targets.dtype)[None, :]
+    grad = (p - (cols == targets[:, None]).astype(jnp.float32)) * g[:, None]
+    return (grad.astype(logits.dtype),
+            np.zeros(targets.shape, dtype=jax.dtypes.float0))
+
+
+_xent.defvjp(_xent_vjp_fwd, _xent_vjp_bwd)
+
+
+def fused_softmax_xent(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Per-token negative log likelihood, fused: [..., V] x [...] -> [...] f32.
+
+    Differentiable w.r.t. ``logits``. Falls back to the XLA formulation when
+    the flattened row count does not tile (``_pick_block_n`` degenerates to
+    single-row instances, e.g. an odd row count).
+    """
+    v = logits.shape[-1]
+    shape = logits.shape[:-1]
+    flat_logits = logits.reshape(-1, v)
+    flat_targets = targets.reshape(-1)
+    n = flat_logits.shape[0]
+    if n > 1 and _pick_block_n(n, v) == 1:
+        # Degenerate tiling (e.g. odd row count): a grid of [1, V] instances
+        # would be a throughput cliff; use the XLA formulation instead.
+        logz = jax.nn.log_softmax(flat_logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logz, flat_targets[:, None], axis=-1)[:, 0]
+    else:
+        nll = _xent(flat_logits, flat_targets)
+    return nll.reshape(shape)
+
+
+def fused_cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Drop-in for ``ops.layers.cross_entropy_loss`` (mean token-wise NLL,
+    reference ``tokenwise_loss_fn`` semantics) through the fused kernel."""
+    return jnp.mean(fused_softmax_xent(logits, targets))

@@ -46,7 +46,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.transformer import (body_apply, embed_apply, head_apply,
                                   transformer_loss)
-from ..ops.layers import cross_entropy_loss
+from ..ops.layers import select_xent
 from ..utils.config import ModelConfig, ScheduleConfig
 from .mesh import DATA_AXIS, PIPE_AXIS
 from .schedules import (COL_BWD_ASLOT, COL_BWD_GSLOT, COL_BWD_M, COL_BWD_V,
@@ -195,7 +195,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             y = stage_body(p_v, x_in)
             return jax.lax.cond(
                 last_stage,
-                lambda: cross_entropy_loss(
+                lambda: select_xent(cfg.use_fused_xent)(
                     head_apply(cfg, head_p, y), targets_mb[mm]),
                 lambda: jnp.sum(y.astype(jnp.float32)
                                 * g_in.astype(jnp.float32)))

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.transformer import ModelConfig
-from ..ops.layers import (cross_entropy_loss, embedding_apply,
+from ..ops.layers import (select_xent, embedding_apply,
                           layer_norm_apply, linear_apply, rms_norm_apply)
 from .mesh import SEQ_AXIS
 from .pipeline import _shard_map
@@ -105,7 +105,7 @@ def make_sp_loss_fn(cfg: ModelConfig, mesh: Mesh, attn_impl: str = "ring",
         else:
             h = layer_norm_apply(params["head"]["norm"], h)
         logits = linear_apply(params["head"]["out"], h)
-        local = cross_entropy_loss(logits, targets)  # mean over local tokens
+        local = select_xent(cfg.use_fused_xent)(logits, targets)  # mean over local tokens
         return jax.lax.psum(local, SEQ_AXIS) / D  # equal chunks -> global mean
 
     return _shard_map(

@@ -43,6 +43,7 @@ class ModelConfig:
     # reference — only throughput — so this does not affect parity).
     dtype: str = "float32"
     use_flash_attention: bool = False  # route attention through the Pallas kernel
+    use_fused_xent: bool = False  # route the loss through the Pallas fused-CE kernel
     remat_layers: bool = False  # jax.checkpoint each layer: trade FLOPs for HBM
     # Llama-only knobs.
     n_kv_heads: Optional[int] = None

@@ -74,11 +74,16 @@ def test_ulysses_attention_grads_match():
 @pytest.mark.parametrize("arch,kw", [
     ("ref_decoder", {}),
     ("gpt2", {}),
-    ("llama", dict(n_kv_heads=2)),  # GQA: heads expand before the all-to-all
+    ("llama", dict(n_kv_heads=2)),  # GQA: h_kv % D != 0, expand before all-to-all
+    # GQA with h_kv divisible by D=4: K/V ride the all-to-all unexpanded and
+    # are gqa_expand-ed locally — the comm-saving branch in ulysses_attention.
+    ("llama", dict(n_heads=8, n_kv_heads=4)),
 ])
 def test_ulysses_seq_parallel_loss_and_grads_match(arch, kw):
-    cfg = dtpp.ModelConfig(dim=32, n_layers=2, n_heads=4, vocab_size=64,
-                           ffn_dim=64, max_seq_len=64, arch=arch, **kw)
+    base = dict(dim=32, n_layers=2, n_heads=4, vocab_size=64,
+                ffn_dim=64, max_seq_len=64, arch=arch)
+    base.update(kw)
+    cfg = dtpp.ModelConfig(**base)
     params = tfm.transformer_init(jax.random.key(0), cfg)
     tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
     targets = jax.random.randint(jax.random.key(2), (2, 32), 0, cfg.vocab_size)
