@@ -19,46 +19,66 @@ from typing import Optional
 import numpy as np
 
 _CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
-_LIB_PATH = os.path.abspath(os.path.join(_CSRC, "libschedule_engine.so"))
-_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_lib_failed = False
+
+
+class NativeLib:
+    """Lazy, cached loader for one csrc/ shared library.
+
+    First use invokes make (mtime-incremental: a no-op when the .so is
+    fresh, a rebuild when the source changed — a stale .so would silently
+    misbehave). If no build toolchain is available but a prebuilt and
+    source-fresh .so exists, it is loaded anyway. ``configure`` receives the
+    CDLL to declare restype/argtypes. Load failure is cached; ``get()``
+    then returns None so callers can fall back to their Python twin.
+    """
+
+    def __init__(self, so_name: str, src_name: str, configure):
+        self._so = os.path.abspath(os.path.join(_CSRC, so_name))
+        self._src = os.path.join(_CSRC, src_name)
+        self._configure = configure
+        self._lock = threading.Lock()
+        self._lib: Optional[ctypes.CDLL] = None
+        self._failed = False
+
+    def get(self) -> Optional[ctypes.CDLL]:
+        with self._lock:
+            if self._lib is not None or self._failed:
+                return self._lib
+            try:
+                try:
+                    subprocess.run(["make", "-C", os.path.abspath(_CSRC)],
+                                   check=True, capture_output=True)
+                except (OSError, subprocess.CalledProcessError):
+                    if not os.path.exists(self._so):
+                        raise
+                    if (os.path.exists(self._src)
+                            and os.path.getmtime(self._so)
+                            < os.path.getmtime(self._src)):
+                        raise  # stale .so relative to source; don't trust it
+                lib = ctypes.CDLL(self._so)
+                self._configure(lib)
+                self._lib = lib
+            except Exception:
+                self._failed = True
+            return self._lib
+
+
+def _configure_schedule_engine(lib: ctypes.CDLL) -> None:
+    lib.dtpp_compile_schedule.restype = ctypes.c_int
+    lib.dtpp_compile_schedule.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_int,
+    ]
+
+
+_engine = NativeLib("libschedule_engine.so", "schedule_engine.cpp",
+                    _configure_schedule_engine)
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _lib_failed
-    with _lock:
-        if _lib is not None or _lib_failed:
-            return _lib
-        try:
-            # Invoke make when possible: it is mtime-incremental, so this is
-            # a no-op when the library is fresh and a rebuild when
-            # schedule_engine.cpp changed (e.g. a table-layout revision) — a
-            # stale .so would silently emit tables in the old layout. If no
-            # build toolchain is available but a prebuilt (and source-fresh)
-            # .so exists, load it anyway.
-            try:
-                subprocess.run(["make", "-C", os.path.abspath(_CSRC)],
-                               check=True, capture_output=True)
-            except (OSError, subprocess.CalledProcessError):
-                if not os.path.exists(_LIB_PATH):
-                    raise
-                src = os.path.join(_CSRC, "schedule_engine.cpp")
-                if (os.path.exists(src)
-                        and os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
-                    raise  # .so is stale relative to the source; don't trust it
-            lib = ctypes.CDLL(_LIB_PATH)
-            lib.dtpp_compile_schedule.restype = ctypes.c_int
-            lib.dtpp_compile_schedule.argtypes = [
-                ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-                ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
-                ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_int,
-            ]
-            _lib = lib
-        except Exception:
-            _lib_failed = True
-        return _lib
+    return _engine.get()
 
 
 def native_available() -> bool:

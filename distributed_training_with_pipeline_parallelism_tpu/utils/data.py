@@ -9,8 +9,9 @@ on the GPT-2/Llama ladder needs an actual input pipeline, TPU-shaped:
 - **Memory-mapped token files** (:class:`TokenFileDataset`): flat binary
   arrays of token ids (the standard GPT-2-style ``.bin`` format) sampled by
   random crop. ``np.memmap`` keeps the host working set at O(touched pages)
-  regardless of corpus size; no native loader is needed because the hot
-  path is the kernel's page cache, not Python.
+  regardless of corpus size. The native production twin is
+  :class:`utils.data_native.NativeTokenLoader` — same semantics, crop
+  assembly in background C++ threads (``csrc/data_loader.cpp``).
 - **Sharded device placement** (:func:`batch_sharding`): batches are laid
   out over the mesh's data axis before the train step runs, so jit consumes
   committed on-device arrays instead of re-transferring host buffers every

@@ -11,6 +11,8 @@ from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
 from distributed_training_with_pipeline_parallelism_tpu.utils.data import (
     TokenFileDataset, batch_sharding, prefetch_to_device, synthetic_batches,
     write_token_file)
+from distributed_training_with_pipeline_parallelism_tpu.utils.data_native import (
+    NativeTokenLoader, native_loader_available)
 
 
 def test_synthetic_next_token_targets():
@@ -91,3 +93,73 @@ def test_batch_sharding_no_data_axis_returns_none():
     mesh = make_mesh(n_pipe=4, n_data=1)
     # 'data' axis exists but size 1 — sharding still valid; drop only when absent
     assert batch_sharding(mesh, axis="nonexistent") is None
+
+
+# ---------------------------------------------------------------------------
+# Native (C++) prefetching loader
+# ---------------------------------------------------------------------------
+
+needs_native_loader = pytest.mark.skipif(
+    not native_loader_available(), reason="no C++ toolchain")
+
+
+@needs_native_loader
+def test_native_loader_crops_are_contiguous_file_slices(tmp_path):
+    # arange content makes validity trivially checkable: every crop must be
+    # a run of consecutive integers, and targets the crop shifted by one.
+    path = tmp_path / "tokens_i32.bin"
+    write_token_file(path, np.arange(5000, dtype=np.int32), dtype=np.int32)
+    with NativeTokenLoader(path, seq_length=16, batch_size=8,
+                           dtype=np.int32, seed=1) as dl:
+        for _ in range(5):
+            toks, tgts = dl.next()
+            assert toks.shape == tgts.shape == (8, 16)
+            assert toks.dtype == tgts.dtype == np.int32
+            np.testing.assert_array_equal(np.diff(toks, axis=1), 1)
+            np.testing.assert_array_equal(tgts, toks + 1)
+            assert toks.min() >= 0 and tgts.max() <= 4999
+
+
+@needs_native_loader
+def test_native_loader_uint16_and_determinism(tmp_path):
+    path = tmp_path / "tokens_u16.bin"
+    rng = np.random.default_rng(0)
+    write_token_file(path, rng.integers(0, 60000, 4096).astype(np.uint16))
+
+    def stream(seed):
+        with NativeTokenLoader(path, seq_length=32, batch_size=4,
+                               seed=seed, n_threads=1) as dl:
+            return [dl.next() for _ in range(4)]
+
+    a, b = stream(7), stream(7)
+    for (ta, ga), (tb, gb) in zip(a, b):
+        np.testing.assert_array_equal(ta, tb)
+        np.testing.assert_array_equal(ga, gb)
+        assert ta.max() < 60000 and ta.min() >= 0
+    c = stream(8)
+    assert any(not np.array_equal(ta, tc) for (ta, _), (tc, _) in zip(a, c))
+
+
+@needs_native_loader
+def test_native_loader_rejects_bad_inputs(tmp_path):
+    path = tmp_path / "tiny.bin"
+    write_token_file(path, np.arange(8, dtype=np.uint16))
+    with pytest.raises(ValueError, match="need at least"):
+        NativeTokenLoader(path, seq_length=16, batch_size=2)
+    with pytest.raises(ValueError, match="cannot open"):
+        NativeTokenLoader(tmp_path / "missing.bin", seq_length=4, batch_size=2)
+    with pytest.raises(ValueError, match="dtype"):
+        NativeTokenLoader(path, seq_length=4, batch_size=2, dtype=np.float32)
+
+
+@needs_native_loader
+def test_native_loader_feeds_prefetch_to_device(tmp_path):
+    # end-to-end: native loader -> device prefetch -> arrays on device
+    path = tmp_path / "tokens.bin"
+    write_token_file(path, np.arange(2048, dtype=np.uint16))
+    with NativeTokenLoader(path, seq_length=8, batch_size=4) as dl:
+        it = prefetch_to_device(dl.batches(), depth=2)
+        for _ in range(3):
+            toks, tgts = next(it)
+            assert toks.shape == (4, 8)
+            np.testing.assert_array_equal(np.asarray(tgts), np.asarray(toks) + 1)
