@@ -13,8 +13,10 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -54,8 +56,9 @@ struct Loader {
   std::vector<std::thread> threads;
   std::deque<std::vector<int32_t>> queue;  // each: [tokens | targets], 2*B*S
   std::mutex mu;
-  std::condition_variable cv_space, cv_item;
+  std::condition_variable cv_space, cv_item, cv_readers;
   std::atomic<bool> stop{false};
+  int readers = 0;  // in-flight dtpp_dl_next calls (guarded by mu)
 
   int32_t tok_at(int64_t i) const {
     return dtype == DTYPE_U16
@@ -166,23 +169,54 @@ void* dtpp_dl_open(const char* path, int64_t seq, int64_t batch, int dtype,
 }
 
 // Blocks until a batch is ready; copies into caller buffers of B*S int32 each.
+// Safe against a concurrent dtpp_dl_close: close() waits for in-flight
+// readers (the `readers` count) before freeing the Loader.
 int dtpp_dl_next(void* handle, int32_t* toks_out, int32_t* tgts_out) {
   auto* ld = static_cast<Loader*>(handle);
   std::vector<int32_t> buf;
+  size_t n = 0;
   {
     std::unique_lock<std::mutex> lk(ld->mu);
+    ++ld->readers;
     ld->cv_item.wait(lk, [&] { return ld->stop.load() || !ld->queue.empty(); });
-    if (ld->queue.empty()) return 1;  // closing
-    buf = std::move(ld->queue.front());
-    ld->queue.pop_front();
-    ld->cv_space.notify_one();
+    const bool closing = ld->queue.empty();
+    if (!closing) {
+      buf = std::move(ld->queue.front());
+      ld->queue.pop_front();
+      ld->cv_space.notify_one();
+      n = static_cast<size_t>(ld->batch * ld->seq);
+    }
+    if (--ld->readers == 0) ld->cv_readers.notify_all();
+    if (closing) return 1;
+    // `ld` must not be touched after unlock: close() may free it as soon as
+    // readers hits zero. Everything needed below is in locals.
   }
-  const size_t n = static_cast<size_t>(ld->batch * ld->seq);
   std::memcpy(toks_out, buf.data(), n * sizeof(int32_t));
   std::memcpy(tgts_out, buf.data() + n, n * sizeof(int32_t));
   return 0;
 }
 
-void dtpp_dl_close(void* handle) { delete static_cast<Loader*>(handle); }
+// Unblock every in-flight and future dtpp_dl_next (they return 1) without
+// freeing the Loader. Callers that may race next() against close() should
+// stop first, drain their readers, then close.
+void dtpp_dl_stop(void* handle) {
+  auto* ld = static_cast<Loader*>(handle);
+  std::lock_guard<std::mutex> lk(ld->mu);
+  ld->stop.store(true);
+  ld->cv_item.notify_all();
+  ld->cv_space.notify_all();
+}
+
+void dtpp_dl_close(void* handle) {
+  auto* ld = static_cast<Loader*>(handle);
+  {
+    std::unique_lock<std::mutex> lk(ld->mu);
+    ld->stop.store(true);
+    ld->cv_item.notify_all();
+    ld->cv_space.notify_all();
+    ld->cv_readers.wait(lk, [&] { return ld->readers == 0; });
+  }
+  delete ld;  // ~Loader joins the (already stopping) worker threads
+}
 
 }  // extern "C"

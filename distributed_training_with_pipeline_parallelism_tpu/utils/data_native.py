@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 from typing import Iterator, Tuple
 
 import numpy as np
@@ -35,6 +36,8 @@ def _configure(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_int32),
     ]
+    lib.dtpp_dl_stop.restype = None
+    lib.dtpp_dl_stop.argtypes = [ctypes.c_void_p]
     lib.dtpp_dl_close.restype = None
     lib.dtpp_dl_close.argtypes = [ctypes.c_void_p]
 
@@ -77,17 +80,32 @@ class NativeTokenLoader:
             raise ValueError(err.value.decode() or "dtpp_dl_open failed")
         self.seq_length = seq_length
         self.batch_size = batch_size
+        # close() must not free the native Loader under a next() blocked in
+        # C (ctypes releases the GIL): next() registers in-flight under this
+        # condition, close() nulls the handle, stops the loader (which
+        # unblocks readers), waits for in-flight to drain, then frees.
+        self._cond = threading.Condition()
+        self._inflight = 0
 
     def next(self) -> Tuple[np.ndarray, np.ndarray]:
-        if self._handle is None:
-            raise RuntimeError("loader is closed")
-        shape = (self.batch_size, self.seq_length)
-        toks = np.empty(shape, np.int32)
-        tgts = np.empty(shape, np.int32)
-        rc = self._lib.dtpp_dl_next(
-            self._handle,
-            toks.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            tgts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        with self._cond:
+            if self._handle is None:
+                raise RuntimeError("loader is closed")
+            handle = self._handle
+            self._inflight += 1
+        try:
+            shape = (self.batch_size, self.seq_length)
+            toks = np.empty(shape, np.int32)
+            tgts = np.empty(shape, np.int32)
+            rc = self._lib.dtpp_dl_next(
+                handle,
+                toks.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                tgts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        finally:
+            with self._cond:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._cond.notify_all()
         if rc != 0:
             raise RuntimeError("loader closed while waiting for a batch")
         return toks, tgts
@@ -97,9 +115,14 @@ class NativeTokenLoader:
             yield self.next()
 
     def close(self) -> None:
-        if self._handle is not None:
-            self._lib.dtpp_dl_close(self._handle)
-            self._handle = None
+        with self._cond:
+            if self._handle is None:
+                return
+            handle, self._handle = self._handle, None
+            self._lib.dtpp_dl_stop(handle)  # unblocks in-flight next() calls
+            while self._inflight:
+                self._cond.wait()
+        self._lib.dtpp_dl_close(handle)
 
     def __enter__(self) -> "NativeTokenLoader":
         return self

@@ -9,6 +9,9 @@ the same pipeline gradients with an optax optimizer under a single jit here.
 
 from __future__ import annotations
 
+import json
+import os
+import time
 from typing import Any, Callable, Iterator, Optional, Tuple
 
 import jax
@@ -17,6 +20,7 @@ import optax
 from jax.sharding import Mesh
 
 from ..parallel.pipeline import make_pipeline_grad_fn
+from .checkpoint import restore_checkpoint, save_checkpoint
 from .config import ModelConfig, ScheduleConfig
 
 Pytree = Any
@@ -54,28 +58,101 @@ def adamw(learning_rate: float = 3e-4, weight_decay: float = 0.01,
     )
 
 
+def _latest_step_dir(checkpoint_dir: str) -> Optional[Tuple[int, str]]:
+    """Find the newest ``step_{n}`` checkpoint under ``checkpoint_dir``."""
+    if not os.path.isdir(checkpoint_dir):
+        return None
+    best = None
+    for name in os.listdir(checkpoint_dir):
+        if name.startswith("step_"):
+            try:
+                n = int(name[len("step_"):])
+            except ValueError:
+                continue
+            if best is None or n > best[0]:
+                best = (n, os.path.join(checkpoint_dir, name))
+    return best
+
+
 def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         data: Iterator[Tuple[jax.Array, jax.Array]], num_steps: int,
         optimizer: Optional[optax.GradientTransformation] = None,
-        log_every: int = 10, verbose: bool = True):
-    """Minimal training loop over a ``(tokens, targets)`` iterator.
+        log_every: int = 10, verbose: bool = True,
+        checkpoint_dir: Optional[str] = None, checkpoint_every: int = 0,
+        resume: bool = False, skip_data_on_resume: bool = True,
+        metrics_path: Optional[str] = None):
+    """Training loop over a ``(tokens, targets)`` iterator.
 
     Returns (params, list of (step, loss)). The data contract matches the
     reference's synthetic setup (random token batches,
     ``LLMsDistributedTrainingHelper.py:191-194``) but accepts any iterator.
+
+    Beyond the minimal loop (capabilities the reference lacks, SURVEY.md §5):
+
+    - ``checkpoint_dir`` + ``checkpoint_every``: save
+      ``{'params', 'opt_state', 'step'}`` to ``step_{n}/`` via Orbax every n
+      steps (and at the end); ``resume=True`` restores the newest one and
+      continues counting from it. With ``skip_data_on_resume`` (default) the
+      completed steps' batches are drained from ``data`` first, so re-running
+      an interrupted job with the same (deterministic) data stream reproduces
+      the uninterrupted run instead of double-training early batches. Pass
+      ``False`` only if the caller re-positions the iterator itself.
+    - ``metrics_path``: append one JSON line per log point —
+      ``{"step", "loss", "tokens_per_sec", "elapsed_s"}`` — the streaming
+      twin of the sweep's metrics dict (same tokens/sec definition:
+      batch*seq*steps / wall-clock between log points).
     """
     optimizer = optimizer or adamw(total_steps=num_steps)
     step_fn = make_train_step(cfg, mesh, sched, optimizer)
     opt_state = optimizer.init(params)
+
+    start_step = 0
+    if resume and checkpoint_dir:
+        latest = _latest_step_dir(checkpoint_dir)
+        if latest is not None:
+            n, path = latest
+            state = restore_checkpoint(path, template={
+                "params": params, "opt_state": opt_state,
+                "step": jnp.asarray(0)})
+            params, opt_state = state["params"], state["opt_state"]
+            start_step = int(state["step"]) + 1
+            if skip_data_on_resume:
+                for _ in range(start_step):
+                    next(data)
+            if verbose:
+                print(f"resumed from {path} (step {n})", flush=True)
+
+    def _save(i):
+        save_checkpoint(os.path.join(checkpoint_dir, f"step_{i}"),
+                        {"params": params, "opt_state": opt_state,
+                         "step": jnp.asarray(i)})
+
     history = []
-    for i in range(num_steps):
+    window_start = time.perf_counter()
+    window_tokens = 0
+    for i in range(start_step, num_steps):
         tokens, targets = next(data)
         params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
+        window_tokens += tokens.shape[0] * tokens.shape[1]
         if i % log_every == 0 or i == num_steps - 1:
-            loss_f = float(loss)
+            loss_f = float(loss)  # device sync: closes the timing window
+            elapsed = time.perf_counter() - window_start
             history.append((i, loss_f))
             if verbose:
                 print(f"step {i}: loss {loss_f:.4f}", flush=True)
+            if metrics_path:
+                with open(metrics_path, "a") as f:
+                    f.write(json.dumps({
+                        "step": i, "loss": loss_f,
+                        "tokens_per_sec": round(window_tokens / elapsed, 2),
+                        "elapsed_s": round(elapsed, 4)}) + "\n")
+            window_start = time.perf_counter()
+            window_tokens = 0
+        if (checkpoint_dir and checkpoint_every
+                and (i + 1) % checkpoint_every == 0 and i != num_steps - 1):
+            _save(i)
+    if checkpoint_dir and checkpoint_every and num_steps > start_step:
+        _save(num_steps - 1)
     return params, history
 
 

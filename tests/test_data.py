@@ -163,3 +163,33 @@ def test_native_loader_feeds_prefetch_to_device(tmp_path):
             toks, tgts = next(it)
             assert toks.shape == (4, 8)
             np.testing.assert_array_equal(np.asarray(tgts), np.asarray(toks) + 1)
+
+
+@needs_native_loader
+def test_native_loader_concurrent_close_while_next_blocked(tmp_path):
+    """close() must hand-shake with a next() blocked on an empty queue
+    (depth exhausted by slow workers is simulated with depth=1 + drain)."""
+    import threading
+    import time as _time
+
+    path = tmp_path / "tokens.bin"
+    write_token_file(path, np.arange(1024, dtype=np.uint16))
+    for trial in range(20):
+        dl = NativeTokenLoader(path, seq_length=8, batch_size=2,
+                               n_threads=1, depth=1, seed=trial)
+        results = []
+
+        def consume():
+            try:
+                while True:
+                    dl.next()
+            except RuntimeError as e:  # "loader closed while waiting"
+                results.append(str(e))
+
+        t = threading.Thread(target=consume)
+        t.start()
+        _time.sleep(0.002)
+        dl.close()  # close under the consumer's feet
+        t.join(timeout=10)
+        assert not t.is_alive(), "consumer thread hung after close()"
+        assert results, "consumer never observed the close"
