@@ -72,37 +72,67 @@ def layer_init(key: jax.Array, cfg: ModelConfig) -> Dict:
 
 
 def layer_apply(cfg: ModelConfig, params: Dict, h: jax.Array,
-                rope_angles: Optional[jax.Array] = None) -> jax.Array:
+                rope_angles: Optional[jax.Array] = None,
+                tp_axis: Optional[str] = None, tp_size: int = 1) -> jax.Array:
+    """One decoder block. With ``tp_axis`` set the block runs Megatron
+    tensor-parallel inside a manual-SPMD region: weight leaves are local
+    shards (attention heads and FFN hidden dim column-split ``tp_size``
+    ways), norms replicated, and the two row-parallel projections complete
+    with a psum (see :mod:`..ops.collectives`)."""
     fl = cfg.use_flash_attention
+    heads = cfg.n_heads // tp_size
     if cfg.arch == "ref_decoder":
         mem = h  # the reference calls layer(h, h): memory is the layer's input
-        x = layer_norm_apply(params["ln1"], h + mha_apply(params["self_attn"], h, h, cfg.n_heads, flash=fl))
-        x = layer_norm_apply(params["ln2"], x + mha_apply(params["cross_attn"], x, mem, cfg.n_heads, flash=fl))
-        ff = linear_apply(params["lin2"], jax.nn.relu(linear_apply(params["lin1"], x)))
+        x = layer_norm_apply(params["ln1"], h + mha_apply(
+            params["self_attn"], h, h, heads, flash=fl, tp_axis=tp_axis))
+        x = layer_norm_apply(params["ln2"], x + mha_apply(
+            params["cross_attn"], x, mem, heads, flash=fl, tp_axis=tp_axis))
+        ff = _ffn_out(params["lin2"],
+                      jax.nn.relu(linear_apply(params["lin1"], _tp_in(x, tp_axis))),
+                      tp_axis)
         return layer_norm_apply(params["ln3"], x + ff)
     if cfg.arch == "gpt2":
         a = layer_norm_apply(params["ln1"], h)
-        h = h + mha_apply(params["attn"], a, a, cfg.n_heads, causal=cfg.causal, flash=fl)
-        return mlp_block(cfg, params, h)
+        h = h + mha_apply(params["attn"], a, a, heads, causal=cfg.causal,
+                          flash=fl, tp_axis=tp_axis)
+        return mlp_block(cfg, params, h, tp_axis=tp_axis)
     if cfg.arch == "llama":
         a = rms_norm_apply(params["rms1"], h, cfg.rms_eps)
-        h = h + mha_apply(params["attn"], a, a, cfg.n_heads, causal=cfg.causal,
-                          rope_angles=rope_angles, flash=fl)
-        return mlp_block(cfg, params, h)
+        h = h + mha_apply(params["attn"], a, a, heads, causal=cfg.causal,
+                          rope_angles=rope_angles, flash=fl, tp_axis=tp_axis)
+        return mlp_block(cfg, params, h, tp_axis=tp_axis)
     raise ValueError(f"unknown arch {cfg.arch!r}")
 
 
-def mlp_block(cfg: ModelConfig, params: Dict, h: jax.Array) -> jax.Array:
+def _tp_in(x: jax.Array, tp_axis: Optional[str]) -> jax.Array:
+    if tp_axis is None:
+        return x
+    from ..ops.collectives import tp_copy
+    return tp_copy(x, tp_axis)
+
+
+def _ffn_out(params: Dict, z: jax.Array, tp_axis: Optional[str]) -> jax.Array:
+    if tp_axis is None:
+        return linear_apply(params, z)
+    from ..ops.collectives import row_parallel_linear
+    return row_parallel_linear(params, z, tp_axis)
+
+
+def mlp_block(cfg: ModelConfig, params: Dict, h: jax.Array,
+              tp_axis: Optional[str] = None) -> jax.Array:
     """Post-attention half of a gpt2/llama block (norm + MLP + residual).
 
     Shared between the training path (:func:`layer_apply`) and the KV-cache
     decode path (:mod:`.generate`) so the two cannot drift."""
     if cfg.arch == "gpt2":
-        m = layer_norm_apply(params["ln2"], h)
-        return h + linear_apply(params["lin2"], jax.nn.gelu(linear_apply(params["lin1"], m)))
-    m = rms_norm_apply(params["rms2"], h, cfg.rms_eps)
-    ff = linear_apply(params["w2"],
-                      jax.nn.silu(linear_apply(params["w1"], m)) * linear_apply(params["w3"], m))
+        m = _tp_in(layer_norm_apply(params["ln2"], h), tp_axis)
+        return h + _ffn_out(params["lin2"],
+                            jax.nn.gelu(linear_apply(params["lin1"], m)),
+                            tp_axis)
+    m = _tp_in(rms_norm_apply(params["rms2"], h, cfg.rms_eps), tp_axis)
+    ff = _ffn_out(params["w2"],
+                  jax.nn.silu(linear_apply(params["w1"], m)) * linear_apply(params["w3"], m),
+                  tp_axis)
     return h + ff
 
 
@@ -144,12 +174,14 @@ def _rope(cfg: ModelConfig, seq_len: int) -> Optional[jax.Array]:
     return rope_frequencies(cfg.head_dim, seq_len, cfg.rope_theta)
 
 
-def body_apply(cfg: ModelConfig, layers: Dict, h: jax.Array) -> jax.Array:
+def body_apply(cfg: ModelConfig, layers: Dict, h: jax.Array,
+               tp_axis: Optional[str] = None, tp_size: int = 1) -> jax.Array:
     """Run a stack of layers whose leaves are stacked on axis 0 (any count)."""
     rope = _rope(cfg, h.shape[1])
 
     def step(carry, layer_params):
-        return layer_apply(cfg, layer_params, carry, rope), None
+        return layer_apply(cfg, layer_params, carry, rope,
+                           tp_axis=tp_axis, tp_size=tp_size), None
 
     if cfg.remat_layers:
         # rematerialize each layer in backward: activation memory drops from

@@ -104,12 +104,25 @@ def qkv_project(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
 
 def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
               causal: bool = False, rope_angles: Optional[jax.Array] = None,
-              flash: bool = False) -> jax.Array:
+              flash: bool = False, tp_axis: Optional[str] = None) -> jax.Array:
     """Attention: queries from ``q_in``, keys/values from ``kv_in`` (both [b, s, d]).
 
     ``flash=True`` routes the core attention through the fused Pallas kernel
     (:mod:`.pallas_attention`) instead of dense XLA softmax-matmuls.
+
+    ``tp_axis`` enables Megatron tensor parallelism inside a manual-SPMD
+    region: the q/k/v/o weight leaves are the caller's *local shards*
+    (heads column-split; ``n_heads`` is the local head count), the inputs
+    are replicated (``tp_copy`` marks them so input cotangents sum), and
+    the output projection is row-parallel (``tp_reduce`` completes it).
     """
+    if tp_axis is not None:
+        from .collectives import row_parallel_linear, tp_copy
+        if kv_in is q_in:  # self-attention: one copy, one backward psum
+            q_in = kv_in = tp_copy(q_in, tp_axis)
+        else:
+            q_in = tp_copy(q_in, tp_axis)
+            kv_in = tp_copy(kv_in, tp_axis)
     q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles)
     if flash:
         from .pallas_attention import flash_attention
@@ -121,4 +134,6 @@ def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
             mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None]
         out = scaled_dot_attention(q, k, v, mask)
     out = out.reshape(q_in.shape[0], q_in.shape[1], -1)
+    if tp_axis is not None:
+        return row_parallel_linear(params["o"], out, tp_axis)
     return linear_apply(params["o"], out)

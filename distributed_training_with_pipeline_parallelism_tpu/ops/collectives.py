@@ -1,0 +1,62 @@
+"""Megatron-style conjugate collective pairs for manual-SPMD tensor
+parallelism (Shoeybi et al., arXiv:1909.08053 §3: the f/g operators).
+
+Inside ``shard_map`` the pipeline executor runs with replication checking
+off, so AD through raw ``psum`` is easy to get subtly wrong; these wrap the
+two patterns with explicit ``custom_vjp``s that encode the correct
+transposes:
+
+- :func:`tp_copy` — identity forward, **psum backward**. Marks a replicated
+  activation entering column-parallel weights: each model shard contributes
+  a partial input-cotangent that must be summed.
+- :func:`tp_reduce` — **psum forward**, identity backward. Completes a
+  row-parallel matmul: partial outputs are summed; the output cotangent is
+  already replicated and flows to every shard unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_copy(x: jax.Array, axis_name: str) -> jax.Array:
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+tp_copy.defvjp(_copy_fwd, _copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_reduce(x: jax.Array, axis_name: str) -> jax.Array:
+    return jax.lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+tp_reduce.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+def row_parallel_linear(params, x: jax.Array, axis_name: str) -> jax.Array:
+    """Row-parallel linear: local ``x @ w`` partial, psum over the model
+    axis, then the (replicated) bias added once."""
+    y = tp_reduce(x @ params["w"], axis_name)
+    if "b" in params:
+        y = y + params["b"]
+    return y

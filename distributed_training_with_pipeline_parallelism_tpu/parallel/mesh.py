@@ -19,6 +19,7 @@ from jax.sharding import Mesh
 
 DATA_AXIS = "data"
 PIPE_AXIS = "pipe"
+MODEL_AXIS = "model"  # tensor_parallel.TP_AXIS aliases this
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
 
@@ -40,18 +41,24 @@ def make_ep_mesh(n_expert: int, devices=None) -> "Mesh":
     return _make_1d_mesh(n_expert, EXPERT_AXIS, devices)
 
 
-def make_mesh(n_pipe: int, n_data: int = 1,
+def make_mesh(n_pipe: int, n_data: int = 1, n_model: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a ('data', 'pipe') mesh over the first n_data*n_pipe devices."""
+    """Build a ('data', 'pipe') mesh — 3-D ('data', 'pipe', 'model') when
+    ``n_model > 1`` — over the first n_data*n_pipe*n_model devices. The
+    model axis is innermost (highest-traffic collectives ride the shortest
+    ICI hops)."""
     devices = list(devices if devices is not None else jax.devices())
-    need = n_pipe * n_data
+    need = n_pipe * n_data * n_model
     if len(devices) < need:
         raise ValueError(
-            f"need {need} devices for mesh (data={n_data}, pipe={n_pipe}), "
-            f"have {len(devices)}; for CPU simulation set "
+            f"need {need} devices for mesh (data={n_data}, pipe={n_pipe}, "
+            f"model={n_model}), have {len(devices)}; for CPU simulation set "
             f"XLA_FLAGS=--xla_force_host_platform_device_count=N before "
             f"importing jax (the JAX analog of the reference's "
             f"gloo-on-localhost trick)")
+    if n_model > 1:
+        grid = np.asarray(devices[:need]).reshape(n_data, n_pipe, n_model)
+        return Mesh(grid, (DATA_AXIS, PIPE_AXIS, MODEL_AXIS))
     grid = np.asarray(devices[:need]).reshape(n_data, n_pipe)
     return Mesh(grid, (DATA_AXIS, PIPE_AXIS))
 

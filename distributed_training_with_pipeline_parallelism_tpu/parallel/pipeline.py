@@ -48,7 +48,7 @@ from ..models.transformer import (body_apply, embed_apply, head_apply,
                                   transformer_loss)
 from ..ops.layers import select_xent
 from ..utils.config import ModelConfig, ScheduleConfig
-from .mesh import DATA_AXIS, PIPE_AXIS
+from .mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 from .schedules import (COL_BWD_ASLOT, COL_BWD_GSLOT, COL_BWD_M, COL_BWD_V,
                         COL_FWD_M, COL_FWD_SLOT, COL_FWD_V, COL_STORE_B_SLOT,
                         COL_STORE_F_SLOT, COL_W_ASLOT, COL_W_GSLOT, COL_W_M,
@@ -134,10 +134,19 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     """
     D = mesh.shape[PIPE_AXIS]
     n_data = mesh.shape.get(DATA_AXIS, 1)
+    T = mesh.shape.get(MODEL_AXIS, 1)
     V = sched.n_virtual
     M = sched.n_microbatches
     cs: CompiledSchedule = _compile(sched.name, D, V, M)
-    if D == 1 and n_data == 1 and V == 1 and not force_tick_executor:
+    tp_axis = MODEL_AXIS if T > 1 else None
+    if T > 1:
+        n_kv = cfg.n_kv_heads or cfg.n_heads
+        if cfg.n_heads % T or n_kv % T or cfg.ffn_dim % T:
+            raise ValueError(
+                f"tensor parallelism needs n_heads ({cfg.n_heads}), "
+                f"n_kv_heads ({n_kv}) and ffn_dim ({cfg.ffn_dim}) divisible "
+                f"by the model-axis size {T}")
+    if D == 1 and n_data == 1 and T == 1 and V == 1 and not force_tick_executor:
         # Degenerate 1-stage pipeline == a plain full-batch train step: the
         # microbatch-accumulated, 1/M-scaled loss/grads equal the full-batch
         # mean exactly (asserted in tests/test_pipeline.py), so skip the tick
@@ -175,7 +184,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         mb_shape = (mb, seq, cfg.dim)
 
         def stage_body(layer_p, x):
-            return body_apply(cfg, layer_p, x)
+            return body_apply(cfg, layer_p, x, tp_axis=tp_axis, tp_size=T)
 
         def select_v(tree, v):
             return jax.tree.map(
@@ -361,10 +370,19 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 (g_layers, g_embed, g_head))
         return loss, g_layers, g_embed, g_head
 
+    if T > 1:
+        # Per-leaf Megatron placement for the stacked layer pytree: heads and
+        # FFN hidden column-split over 'model', o/down row-split; the model
+        # axis slices each device's weight shards, so the stage body sees
+        # local shards and n_heads/T local heads.
+        from .tensor_parallel import pipeline_layer_specs
+        layer_spec = pipeline_layer_specs(cfg, PIPE_AXIS)
+    else:
+        layer_spec = P(PIPE_AXIS)
     sharded = _shard_map(
         spmd_fn, mesh,
-        in_specs=(P(PIPE_AXIS), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(), P(PIPE_AXIS), P(), P()),
+        in_specs=(layer_spec, P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), layer_spec, P(), P()),
     )
 
     def step(params, tokens, targets):

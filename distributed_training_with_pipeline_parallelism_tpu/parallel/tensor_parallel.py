@@ -21,9 +21,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.transformer import transformer_loss
 from ..utils.config import ModelConfig
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, MODEL_AXIS
 
-TP_AXIS = "model"
+TP_AXIS = MODEL_AXIS  # one axis-name constant: pipeline TP shards onto it
 
 Pytree = Any
 
@@ -59,6 +59,17 @@ def _layer_specs(cfg: ModelConfig) -> dict:
         return {"rms1": rms, "attn": attn_nb, "rms2": rms,
                 "w1": col_nb, "w2": row_nb, "w3": col_nb}
     raise ValueError(cfg.arch)
+
+
+def pipeline_layer_specs(cfg: ModelConfig, pipe_axis: str) -> dict:
+    """Specs for the pipeline executor's stacked layer layout
+    ``[devices, virtual, layers_per_stage, ...]``: the single leading layer
+    axis of :func:`_layer_specs` becomes (pipe, None, None), the Megatron
+    column/row placement of the trailing weight dims carries over. This is
+    what lets TP compose with PP on a 3-D ``data x pipe x model`` mesh."""
+    return jax.tree.map(
+        lambda s: P(pipe_axis, None, None, *s[1:]), _layer_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P))
 
 
 def param_specs(cfg: ModelConfig) -> dict:

@@ -1,0 +1,100 @@
+"""3-D parallelism: Megatron TP composed inside the pipeline executor.
+
+A (data x pipe x model) mesh runs the same verified tick schedules with
+per-stage weights further column/row-split over 'model'; loss and grads
+must still equal single-device autodiff — the same oracle every other
+executor configuration is held to.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.models import transformer as tfm
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import make_mesh
+from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+    make_pipeline_step)
+
+
+def _problem(cfg, seed=0, batch=8, seq=6):
+    params = tfm.transformer_init(jax.random.key(seed), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (batch, seq), 0, cfg.vocab_size)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(cfg, p, tokens, targets))(params)
+    return params, tokens, targets, ref_loss, ref_grads
+
+
+def _check(step, params, tokens, targets, ref_loss, ref_grads, tol=2e-5):
+    loss, grads = step(params, tokens, targets)
+    assert float(jnp.abs(loss - ref_loss)) < tol
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                       grads, ref_grads)
+    worst = max(jax.tree.leaves(err))
+    assert worst < tol, f"max grad err {worst}"
+
+
+@pytest.mark.parametrize("arch,kw", [
+    ("ref_decoder", {}),
+    ("gpt2", {}),
+    ("llama", dict(n_kv_heads=2)),  # GQA: kv heads also split over 'model'
+])
+@pytest.mark.parametrize("name", ["GPipe", "1F1B"])
+def test_pp_tp_matches_single_device(arch, kw, name):
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=16, arch=arch, **kw)
+    prob = _problem(cfg)
+    mesh = make_mesh(n_pipe=2, n_model=2)
+    step = make_pipeline_step(
+        cfg, mesh, dtpp.ScheduleConfig(name=name, n_microbatches=4))
+    _check(step, *prob)
+
+
+def test_full_3d_dp_pp_tp():
+    """data=2 x pipe=2 x model=2 on the 8-device sim mesh."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, arch="gpt2")
+    prob = _problem(cfg)
+    mesh = make_mesh(n_pipe=2, n_data=2, n_model=2)
+    step = make_pipeline_step(
+        cfg, mesh, dtpp.ScheduleConfig(name="1F1B", n_microbatches=2))
+    _check(step, *prob)
+
+
+def test_tp_with_interleaved_virtual_stages():
+    cfg = dtpp.ModelConfig(dim=32, n_layers=8, n_heads=4, vocab_size=64,
+                           ffn_dim=64, arch="gpt2")
+    prob = _problem(cfg)
+    mesh = make_mesh(n_pipe=2, n_model=2)
+    step = make_pipeline_step(
+        cfg, mesh, dtpp.ScheduleConfig(name="Interleaved1F1B",
+                                       n_microbatches=4, n_virtual=2))
+    _check(step, *prob)
+
+
+def test_tp_rejects_indivisible_shapes():
+    cfg = dtpp.ModelConfig(dim=30, n_layers=4, n_heads=3, vocab_size=64,
+                           ffn_dim=64, arch="gpt2")
+    mesh = make_mesh(n_pipe=2, n_model=2)
+    with pytest.raises(ValueError, match="divisible"):
+        make_pipeline_step(cfg, mesh, dtpp.ScheduleConfig(name="GPipe",
+                                                          n_microbatches=4))
+
+
+def test_grads_are_genuinely_sharded_over_model():
+    """The point of TP: each model-shard's weight grads live sharded — check
+    the returned (global) grads reassemble to full shapes and that the
+    executor ran with a 3-axis mesh."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, arch="llama")
+    params, tokens, targets, *_ = _problem(cfg)
+    mesh = make_mesh(n_pipe=2, n_model=2)
+    assert mesh.shape["model"] == 2
+    step = make_pipeline_step(
+        cfg, mesh, dtpp.ScheduleConfig(name="GPipe", n_microbatches=4))
+    _, grads = step(params, tokens, targets)
+    same = jax.tree.map(lambda g, p: g.shape == p.shape, grads, params)
+    assert all(jax.tree.leaves(same))
