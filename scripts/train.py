@@ -29,6 +29,9 @@ def main():
     ap.add_argument("--schedule", default="1F1B", choices=list(SCHEDULE_NAMES))
     ap.add_argument("--pipe", type=int, default=2)
     ap.add_argument("--data", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel (model-axis) size; composes with "
+                         "--pipe/--data into a 3-D mesh")
     ap.add_argument("--virtual", type=int, default=1)
     ap.add_argument("--microbatches", type=int, default=4)
     ap.add_argument("--steps", type=int, default=50)
@@ -36,8 +39,19 @@ def main():
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--dtype", default="float32")
-    ap.add_argument("--ckpt", default="", help="checkpoint dir (save at end)")
-    ap.add_argument("--resume", default="", help="checkpoint dir to load")
+    ap.add_argument("--flash", action="store_true",
+                    help="Pallas fused flash attention")
+    ap.add_argument("--fused-xent", action="store_true",
+                    help="Pallas fused cross-entropy loss")
+    ap.add_argument("--ckpt", default="",
+                    help="checkpoint dir: step-numbered saves + final")
+    ap.add_argument("--ckpt-every", type=int, default=0,
+                    help="save every N steps (default: final only)")
+    ap.add_argument("--auto-resume", action="store_true",
+                    help="resume from the newest checkpoint in --ckpt")
+    ap.add_argument("--metrics", default="",
+                    help="append per-log-point JSON lines here")
+    ap.add_argument("--resume", default="", help="params checkpoint to load")
     ap.add_argument("--simulate-devices", type=int, default=0)
     # overrides to scale models down for smoke runs
     ap.add_argument("--dim", type=int, default=0,
@@ -50,9 +64,20 @@ def main():
     ap.add_argument("--data-file", default="",
                     help="flat binary token file (uint16 ids); default is "
                          "the reference's synthetic random-token regime")
+    ap.add_argument("--native-loader", action="store_true",
+                    help="read --data-file through the C++ prefetching "
+                         "loader (csrc/data_loader.cpp)")
+    ap.add_argument("--loader-threads", type=int, default=1,
+                    help="native-loader worker threads; 1 (default) keeps "
+                         "the batch stream deterministic in --seed, which "
+                         "--auto-resume's data replay depends on")
     ap.add_argument("--prefetch", type=int, default=2,
                     help="device-prefetch depth (0 disables)")
     args = ap.parse_args()
+    if args.native_loader and not args.data_file:
+        ap.error("--native-loader requires --data-file")
+    if args.auto_resume and not args.ckpt:
+        ap.error("--auto-resume requires --ckpt (the dir holding step_N/)")
 
     if args.simulate_devices:
         from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
@@ -67,7 +92,7 @@ def main():
     from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import make_mesh
     from distributed_training_with_pipeline_parallelism_tpu.utils import train
     from distributed_training_with_pipeline_parallelism_tpu.utils.checkpoint import (
-        restore_checkpoint, save_checkpoint)
+        restore_checkpoint)
 
     def build_cfg(**overrides):
         if args.model.startswith("gpt2-"):
@@ -83,31 +108,57 @@ def main():
         n_heads=args.heads,
     ).items() if v}
     overrides["dtype"] = args.dtype
+    if args.flash:
+        overrides["use_flash_attention"] = True
+    if args.fused_xent:
+        overrides["use_fused_xent"] = True
     if args.dim and not args.ffn:
         # keep the family's FFN:dim ratio when scaling width down/up
         base = build_cfg()
         overrides["ffn_dim"] = max(1, round(base.ffn_dim * args.dim / base.dim))
     cfg = build_cfg(**overrides)
 
-    mesh = make_mesh(n_pipe=args.pipe, n_data=args.data)
+    mesh = make_mesh(n_pipe=args.pipe, n_data=args.data, n_model=args.tp)
     sched = dtpp.ScheduleConfig(name=args.schedule,
                                 n_microbatches=args.microbatches,
                                 n_virtual=args.virtual)
     print(f"model={args.model} {cfg.dim}d x {cfg.n_layers}L x {cfg.n_heads}H, "
-          f"mesh=(data={args.data}, pipe={args.pipe}), {args.schedule} "
-          f"M={args.microbatches} V={args.virtual}", flush=True)
+          f"mesh=(data={args.data}, pipe={args.pipe}, model={args.tp}), "
+          f"{args.schedule} M={args.microbatches} V={args.virtual}", flush=True)
 
+    optimizer = train.adamw(learning_rate=args.lr, total_steps=args.steps)
     if args.resume:
-        template = jax.eval_shape(lambda: tfm.transformer_init(
+        import jax.numpy as jnp
+        params_t = jax.eval_shape(lambda: tfm.transformer_init(
             jax.random.key(args.seed), cfg))
-        params = restore_checkpoint(args.resume, template=template)
-        print(f"resumed from {args.resume}", flush=True)
+        # Accept either layout: a fit()-style dir of step_N/ trees
+        # ({'params','opt_state','step'}), a single step_N dir, or a bare
+        # params checkpoint (e.g. converted HF weights).
+        path = args.resume
+        latest = train._latest_step_dir(path)
+        if latest is not None:
+            path = latest[1]
+        try:
+            state = restore_checkpoint(path, template={
+                "params": params_t,
+                "opt_state": jax.eval_shape(optimizer.init, params_t),
+                "step": jnp.asarray(0)})
+            params = state["params"]
+        except Exception:
+            params = restore_checkpoint(path, template=params_t)
+        print(f"loaded params from {path}", flush=True)
     else:
         params = tfm.transformer_init(jax.random.key(args.seed), cfg)
 
     from distributed_training_with_pipeline_parallelism_tpu.utils.data import (
         TokenFileDataset, batch_sharding, prefetch_to_device)
-    if args.data_file:
+    if args.data_file and args.native_loader:
+        from distributed_training_with_pipeline_parallelism_tpu.utils.data_native import (
+            NativeTokenLoader)
+        data = NativeTokenLoader(args.data_file, args.seq, args.batch,
+                                 seed=args.seed,
+                                 n_threads=args.loader_threads).batches()
+    elif args.data_file:
         data = TokenFileDataset(args.data_file, args.seq,
                                 seed=args.seed).batches(args.batch)
     else:
@@ -115,12 +166,14 @@ def main():
     if args.prefetch > 0:
         data = prefetch_to_device(data, depth=args.prefetch,
                                   sharding=batch_sharding(mesh))
-    optimizer = train.adamw(learning_rate=args.lr, total_steps=args.steps)
-    params, history = train.fit(cfg, mesh, sched, params, data, args.steps,
-                                optimizer=optimizer, log_every=max(1, args.steps // 20))
+    params, history = train.fit(
+        cfg, mesh, sched, params, data, args.steps, optimizer=optimizer,
+        log_every=max(1, args.steps // 20),
+        checkpoint_dir=args.ckpt or None,
+        checkpoint_every=(args.ckpt_every or args.steps) if args.ckpt else 0,
+        resume=args.auto_resume, metrics_path=args.metrics or None)
     if args.ckpt:
-        save_checkpoint(args.ckpt, params)
-        print(f"saved checkpoint to {args.ckpt}", flush=True)
+        print(f"checkpoints in {args.ckpt}", flush=True)
     if history:
         print(f"final loss: {history[-1][1]:.4f}", flush=True)
 
