@@ -41,26 +41,30 @@ def make_ep_mesh(n_expert: int, devices=None) -> "Mesh":
     return _make_1d_mesh(n_expert, EXPERT_AXIS, devices)
 
 
-def make_mesh(n_pipe: int, n_data: int = 1, n_model: int = 1,
+def make_mesh(n_pipe: int, n_data: int = 1, n_model: int = 1, n_seq: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a ('data', 'pipe') mesh — 3-D ('data', 'pipe', 'model') when
-    ``n_model > 1`` — over the first n_data*n_pipe*n_model devices. The
-    model axis is innermost (highest-traffic collectives ride the shortest
-    ICI hops)."""
+    """Build the pipeline mesh: ('data', 'pipe'), growing a 'model' axis
+    (tensor parallelism inside stages) and/or a 'seq' axis (ring-attention
+    sequence parallelism inside stages) when those sizes exceed 1. Extra
+    axes are innermost — the highest-traffic collectives ride the shortest
+    ICI hops."""
     devices = list(devices if devices is not None else jax.devices())
-    need = n_pipe * n_data * n_model
+    sizes = [("n_data", DATA_AXIS, n_data), ("n_pipe", PIPE_AXIS, n_pipe)]
+    if n_model > 1:
+        sizes.append(("n_model", MODEL_AXIS, n_model))
+    if n_seq > 1:
+        sizes.append(("n_seq", SEQ_AXIS, n_seq))
+    need = int(np.prod([n for _, _, n in sizes]))
     if len(devices) < need:
+        detail = ", ".join(f"{name[2:]}={n}" for name, _, n in sizes)
         raise ValueError(
-            f"need {need} devices for mesh (data={n_data}, pipe={n_pipe}, "
-            f"model={n_model}), have {len(devices)}; for CPU simulation set "
+            f"need {need} devices for mesh ({detail}), have {len(devices)}; "
+            f"for CPU simulation set "
             f"XLA_FLAGS=--xla_force_host_platform_device_count=N before "
             f"importing jax (the JAX analog of the reference's "
             f"gloo-on-localhost trick)")
-    if n_model > 1:
-        grid = np.asarray(devices[:need]).reshape(n_data, n_pipe, n_model)
-        return Mesh(grid, (DATA_AXIS, PIPE_AXIS, MODEL_AXIS))
-    grid = np.asarray(devices[:need]).reshape(n_data, n_pipe)
-    return Mesh(grid, (DATA_AXIS, PIPE_AXIS))
+    grid = np.asarray(devices[:need]).reshape([n for _, _, n in sizes])
+    return Mesh(grid, tuple(axis for _, axis, _ in sizes))
 
 
 def init_multihost(coordinator_address: Optional[str] = None,

@@ -48,7 +48,7 @@ from ..models.transformer import (body_apply, embed_apply, head_apply,
                                   transformer_loss)
 from ..ops.layers import select_xent
 from ..utils.config import ModelConfig, ScheduleConfig
-from .mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+from .mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
 from .schedules import (COL_BWD_ASLOT, COL_BWD_GSLOT, COL_BWD_M, COL_BWD_V,
                         COL_FWD_M, COL_FWD_SLOT, COL_FWD_V, COL_STORE_B_SLOT,
                         COL_STORE_F_SLOT, COL_W_ASLOT, COL_W_GSLOT, COL_W_M,
@@ -135,10 +135,12 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     D = mesh.shape[PIPE_AXIS]
     n_data = mesh.shape.get(DATA_AXIS, 1)
     T = mesh.shape.get(MODEL_AXIS, 1)
+    n_seq = mesh.shape.get(SEQ_AXIS, 1)
     V = sched.n_virtual
     M = sched.n_microbatches
     cs: CompiledSchedule = _compile(sched.name, D, V, M)
     tp_axis = MODEL_AXIS if T > 1 else None
+    sp_axis = SEQ_AXIS if n_seq > 1 else None
     if T > 1:
         n_kv = cfg.n_kv_heads or cfg.n_heads
         if cfg.n_heads % T or n_kv % T or cfg.ffn_dim % T:
@@ -146,7 +148,12 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 f"tensor parallelism needs n_heads ({cfg.n_heads}), "
                 f"n_kv_heads ({n_kv}) and ffn_dim ({cfg.ffn_dim}) divisible "
                 f"by the model-axis size {T}")
-    if D == 1 and n_data == 1 and T == 1 and V == 1 and not force_tick_executor:
+    if T > 1 and n_seq > 1:
+        raise NotImplementedError(
+            "tensor and sequence parallelism are not yet composed inside "
+            "one pipeline stage; use a model axis OR a seq axis with pipe")
+    if (D == 1 and n_data == 1 and T == 1 and n_seq == 1 and V == 1
+            and not force_tick_executor):
         # Degenerate 1-stage pipeline == a plain full-batch train step: the
         # microbatch-accumulated, 1/M-scaled loss/grads equal the full-batch
         # mean exactly (asserted in tests/test_pipeline.py), so skip the tick
@@ -184,7 +191,17 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         mb_shape = (mb, seq, cfg.dim)
 
         def stage_body(layer_p, x):
-            return body_apply(cfg, layer_p, x, tp_axis=tp_axis, tp_size=T)
+            if sp_axis is None:
+                return body_apply(cfg, layer_p, x, tp_axis=tp_axis, tp_size=T)
+            # sequence-sharded stage: ring attention across the 'seq' axis
+            from .seq_parallel import sp_body_apply
+            return sp_body_apply(cfg, layer_p, x, sp_axis)
+
+        def stage_embed(embed_p, toks):
+            if sp_axis is None:
+                return embed_apply(cfg, embed_p, toks)
+            from .seq_parallel import sp_embed_apply
+            return sp_embed_apply(cfg, embed_p, toks, sp_axis)
 
         def select_v(tree, v):
             return jax.tree.map(
@@ -202,10 +219,18 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             through the head on the last stage, else the contraction of the
             stage output with the incoming cotangent."""
             y = stage_body(p_v, x_in)
+
+            def loss_branch():
+                local = select_xent(cfg.use_fused_xent)(
+                    head_apply(cfg, head_p, y), targets_mb[mm])
+                # seq-sharded: each shard's objective is its local-mean/n_seq
+                # share; the shards' implicit SPMD sum IS the global token
+                # mean, so AD needs no collective here. The reported loss is
+                # psum'd over 'seq' once, outside the schedule (below).
+                return local if sp_axis is None else local / n_seq
+
             return jax.lax.cond(
-                last_stage,
-                lambda: select_xent(cfg.use_fused_xent)(
-                    head_apply(cfg, head_p, y), targets_mb[mm]),
+                last_stage, loss_branch,
                 lambda: jnp.sum(y.astype(jnp.float32)
                                 * g_in.astype(jnp.float32)))
 
@@ -225,7 +250,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 vv, mm = jnp.maximum(fv, 0), jnp.maximum(fm, 0)
                 ss = jnp.maximum(fslot, 0)
                 first_stage = is_first_dev & (vv == 0)
-                x_emb = embed_apply(cfg, embed, tokens_mb[mm]).astype(dtype)
+                x_emb = stage_embed(embed, tokens_mb[mm]).astype(dtype)
                 x = jnp.where(first_stage, x_emb, act_buf[ss])
                 act_buf = act_buf.at[ss].set(x)  # saved for remat backward
                 y = stage_body(select_v(layers_local, vv), x)
@@ -234,7 +259,18 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             def fwd_noop(act_buf):
                 return act_buf, jnp.zeros(mb_shape, dtype)
 
-            act_buf, fwd_send = jax.lax.cond(fm >= 0, fwd_unit, fwd_noop, act_buf)
+            if sp_axis is None:
+                act_buf, fwd_send = jax.lax.cond(fm >= 0, fwd_unit, fwd_noop,
+                                                 act_buf)
+            else:
+                # ring attention's ppermutes are flat-pair collectives: every
+                # device must execute them each tick, so run the unit
+                # unconditionally and mask its effects instead of cond-ing
+                # around it (see tests/test_sp_pipeline.py)
+                new_buf, y = fwd_unit(act_buf)
+                f_active = fm >= 0
+                act_buf = jnp.where(f_active, new_buf, act_buf)
+                fwd_send = jnp.where(f_active, y, jnp.zeros(mb_shape, dtype))
 
             # 3. backward unit (rematerializing)
             bv, bm = row[COL_BWD_V], row[COL_BWD_M]
@@ -259,8 +295,15 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 def dgrad_noop(loss_acc):
                     return loss_acc, jnp.zeros(mb_shape, dtype)
 
-                loss_acc, bwd_send = jax.lax.cond(
-                    bm >= 0, dgrad_unit, dgrad_noop, loss_acc)
+                if sp_axis is None:
+                    loss_acc, bwd_send = jax.lax.cond(
+                        bm >= 0, dgrad_unit, dgrad_noop, loss_acc)
+                else:
+                    new_loss, gx = dgrad_unit(loss_acc)
+                    b_active = bm >= 0
+                    loss_acc = jnp.where(b_active, new_loss, loss_acc)
+                    bwd_send = jnp.where(b_active, gx,
+                                         jnp.zeros(mb_shape, dtype))
 
                 wv, wm = row[COL_W_V], row[COL_W_M]
 
@@ -286,14 +329,20 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                         lambda: jax.tree.map(
                             jnp.add, g_embed,
                             jax.grad(lambda e: jnp.vdot(
-                                embed_apply(cfg, e, tokens_mb[mm]).astype(jnp.float32),
+                                stage_embed(e, tokens_mb[mm]).astype(jnp.float32),
                                 gx.astype(jnp.float32)))(embed)),
                         lambda: g_embed)
                     return (g_layers, g_embed, g_head)
 
-                (g_layers, g_embed, g_head) = jax.lax.cond(
-                    wm >= 0, wgrad_unit, lambda op: op,
-                    (g_layers, g_embed, g_head))
+                if sp_axis is None:
+                    (g_layers, g_embed, g_head) = jax.lax.cond(
+                        wm >= 0, wgrad_unit, lambda op: op,
+                        (g_layers, g_embed, g_head))
+                else:
+                    new_g = wgrad_unit((g_layers, g_embed, g_head))
+                    (g_layers, g_embed, g_head) = jax.tree.map(
+                        lambda new, old: jnp.where(wm >= 0, new, old),
+                        new_g, (g_layers, g_embed, g_head))
 
                 fwd_recv = jax.lax.ppermute(fwd_send, PIPE_AXIS, fwd_perm)
                 bwd_recv = jax.lax.ppermute(bwd_send, PIPE_AXIS, bwd_perm)
@@ -321,7 +370,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     lambda: jax.tree.map(
                         jnp.add, g_embed,
                         jax.grad(lambda e: jnp.vdot(
-                            embed_apply(cfg, e, tokens_mb[mm]).astype(jnp.float32),
+                            stage_embed(e, tokens_mb[mm]).astype(jnp.float32),
                             gx.astype(jnp.float32)))(embed)),
                     lambda: g_embed)
                 loss_acc = loss_acc + jnp.where(last_stage, loss_val, 0.0)
@@ -330,9 +379,17 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             def bwd_noop(operand):
                 return operand, jnp.zeros(mb_shape, dtype)
 
-            (g_layers, g_embed, g_head, loss_acc), bwd_send = jax.lax.cond(
-                bm >= 0, bwd_unit, bwd_noop,
-                (g_layers, g_embed, g_head, loss_acc))
+            if sp_axis is None:
+                (g_layers, g_embed, g_head, loss_acc), bwd_send = jax.lax.cond(
+                    bm >= 0, bwd_unit, bwd_noop,
+                    (g_layers, g_embed, g_head, loss_acc))
+            else:
+                new_state, gx = bwd_unit((g_layers, g_embed, g_head, loss_acc))
+                b_active = bm >= 0
+                (g_layers, g_embed, g_head, loss_acc) = jax.tree.map(
+                    lambda new, old: jnp.where(b_active, new, old),
+                    new_state, (g_layers, g_embed, g_head, loss_acc))
+                bwd_send = jnp.where(b_active, gx, jnp.zeros(mb_shape, dtype))
 
             # 4. ring transfer: activations +1, gradients -1 (ICI hops)
             fwd_recv = jax.lax.ppermute(fwd_send, PIPE_AXIS, fwd_perm)
@@ -359,6 +416,9 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         # (upstream scale_grads semantics) and mean over data replicas.
         inv = 1.0 / M
         loss = jax.lax.psum(loss_acc, PIPE_AXIS) * inv
+        if n_seq > 1:
+            # each shard accumulated local_mean/n_seq -> sum = global mean
+            loss = jax.lax.psum(loss, SEQ_AXIS)
         g_layers = jax.tree.map(lambda x: x[None] * inv, g_layers)
         g_embed = jax.tree.map(lambda x: jax.lax.psum(x * inv, PIPE_AXIS), g_embed)
         g_head = jax.tree.map(lambda x: jax.lax.psum(x * inv, PIPE_AXIS), g_head)
@@ -367,6 +427,13 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             loss = jax.lax.psum(loss * nd, DATA_AXIS)
             g_layers, g_embed, g_head = jax.tree.map(
                 lambda x: jax.lax.psum(x * nd, DATA_AXIS),
+                (g_layers, g_embed, g_head))
+        if n_seq > 1:
+            # each seq shard holds its local-token share of d(global mean
+            # loss)/d(params); the full grad is their unscaled sum (loss is
+            # already the global mean and replicated across 'seq')
+            g_layers, g_embed, g_head = jax.tree.map(
+                lambda x: jax.lax.psum(x, SEQ_AXIS),
                 (g_layers, g_embed, g_head))
         return loss, g_layers, g_embed, g_head
 
@@ -379,9 +446,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         layer_spec = pipeline_layer_specs(cfg, PIPE_AXIS)
     else:
         layer_spec = P(PIPE_AXIS)
+    batch_spec = P(DATA_AXIS, SEQ_AXIS) if n_seq > 1 else P(DATA_AXIS)
     sharded = _shard_map(
         spmd_fn, mesh,
-        in_specs=(layer_spec, P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(layer_spec, P(), P(), batch_spec, batch_spec),
         out_specs=(P(), layer_spec, P(), P()),
     )
 

@@ -68,6 +68,37 @@ def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
     raise ValueError(f"unknown arch {cfg.arch!r}")
 
 
+def sp_embed_apply(cfg: ModelConfig, embed, tokens: jax.Array,
+                   axis_name: str) -> jax.Array:
+    """Sequence-sharded embed: token lookup plus (gpt2) the learned position
+    rows offset by this shard's global position. Shared by the standalone
+    sp loss and the pipeline executor's seq-sharded stages."""
+    x = embedding_apply(embed["tok"], tokens)
+    if cfg.arch == "gpt2":
+        my = jax.lax.axis_index(axis_name)
+        s_local = tokens.shape[1]
+        x = x + jax.lax.dynamic_slice_in_dim(
+            embed["pos"], my * s_local, s_local, axis=0)
+    return x
+
+
+def sp_body_apply(cfg: ModelConfig, layers, h: jax.Array, axis_name: str,
+                  attn_impl: str = "ring") -> jax.Array:
+    """Sequence-sharded twin of ``models.transformer.body_apply``: scan the
+    stacked layers with ring/Ulysses attention over ``axis_name``."""
+    rope = (local_rope_angles(cfg, h.shape[1], axis_name)
+            if cfg.arch == "llama" else None)
+
+    def step(carry, layer_params):
+        return sp_layer_apply(cfg, layer_params, carry, axis_name, rope,
+                              attn_impl=attn_impl), None
+
+    if cfg.remat_layers:
+        step = jax.checkpoint(step)
+    h, _ = jax.lax.scan(step, h, layers)
+    return h
+
+
 def make_sp_loss_fn(cfg: ModelConfig, mesh: Mesh, attn_impl: str = "ring",
                     ) -> Callable[[Pytree, jax.Array, jax.Array], jax.Array]:
     """Sequence-parallel loss: ``(params, tokens, targets) -> scalar``.
@@ -84,22 +115,10 @@ def make_sp_loss_fn(cfg: ModelConfig, mesh: Mesh, attn_impl: str = "ring",
 
     def spmd_loss(params, tokens, targets):
         # tokens/targets arrive as [B, S/D] local chunks
-        my = jax.lax.axis_index(SEQ_AXIS)
-        s_local = tokens.shape[1]
-        h = embedding_apply(params["embed"]["tok"], tokens)
-        if cfg.arch == "gpt2":
-            pos = jax.lax.dynamic_slice_in_dim(
-                params["embed"]["pos"], my * s_local, s_local, axis=0)
-            h = h + pos
+        h = sp_embed_apply(cfg, params["embed"], tokens, SEQ_AXIS)
         h = h.astype(jnp.dtype(cfg.dtype))
-        rope = (local_rope_angles(cfg, s_local, SEQ_AXIS)
-                if cfg.arch == "llama" else None)
-
-        def step(carry, layer_params):
-            return sp_layer_apply(cfg, layer_params, carry, SEQ_AXIS, rope,
-                                  attn_impl=attn_impl), None
-
-        h, _ = jax.lax.scan(step, h, params["layers"])
+        h = sp_body_apply(cfg, params["layers"], h, SEQ_AXIS,
+                          attn_impl=attn_impl)
         if cfg.arch == "llama":
             h = rms_norm_apply(params["head"]["norm"], h, cfg.rms_eps)
         else:

@@ -138,13 +138,14 @@ def main():
         latest = train._latest_step_dir(path)
         if latest is not None:
             path = latest[1]
-        try:
+        if os.path.basename(os.path.normpath(path)).startswith("step_"):
+            # fit()-style full training state
             state = restore_checkpoint(path, template={
                 "params": params_t,
                 "opt_state": jax.eval_shape(optimizer.init, params_t),
                 "step": jnp.asarray(0)})
             params = state["params"]
-        except Exception:
+        else:  # bare params checkpoint (e.g. converted HF weights)
             params = restore_checkpoint(path, template=params_t)
         print(f"loaded params from {path}", flush=True)
     else:
