@@ -32,11 +32,14 @@ BASELINE_TOKS_PER_SEC = 1671.32  # GPipe L8/H8 2 procs, reference cell 25
 
 def run(batch_size: int = 32, seq_length: int = 128, num_iterations: int = 20,
         schedule: str = "GPipe", n_microbatches: int = 4,
-        dtype: str = "bfloat16") -> dict:
+        dtype: str = "bfloat16", use_fused_xent: bool = True) -> dict:
     n_devices = len(jax.devices())
     n_pipe = n_devices  # 1-D pipeline mesh over every visible chip
     # reference defaults (dim 768, L8, H8, vocab 10k) in the MXU-native dtype
-    cfg = dtpp.ModelConfig(dtype=dtype)
+    # fused cross-entropy (our Pallas kernel) is on by default for the
+    # headline: measured ~+1% on this config (docs/performance.md); pass
+    # use_fused_xent=False to time the plain-XLA loss path
+    cfg = dtpp.ModelConfig(dtype=dtype, use_fused_xent=use_fused_xent)
     sched = dtpp.ScheduleConfig(name=schedule, n_microbatches=n_microbatches)
     mesh = make_mesh(n_pipe=n_pipe)
     step = make_pipeline_step(cfg, mesh, sched)
@@ -71,7 +74,8 @@ def run(batch_size: int = 32, seq_length: int = 128, num_iterations: int = 20,
     throughput = tokens_processed / elapsed
     return {
         "metric": f"pipeline train-step throughput ({schedule}, L8/H8, "
-                  f"batch {batch_size}, seq {seq_length}, {n_pipe}-stage, {dtype})",
+                  f"batch {batch_size}, seq {seq_length}, {n_pipe}-stage, "
+                  f"{dtype}{', fused-CE' if use_fused_xent else ''})",
         "value": round(throughput, 2),
         "unit": "tokens/sec",
         "vs_baseline": round(throughput / BASELINE_TOKS_PER_SEC, 3),

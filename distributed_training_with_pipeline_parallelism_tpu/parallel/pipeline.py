@@ -148,10 +148,6 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 f"tensor parallelism needs n_heads ({cfg.n_heads}), "
                 f"n_kv_heads ({n_kv}) and ffn_dim ({cfg.ffn_dim}) divisible "
                 f"by the model-axis size {T}")
-    if T > 1 and n_seq > 1:
-        raise NotImplementedError(
-            "tensor and sequence parallelism are not yet composed inside "
-            "one pipeline stage; use a model axis OR a seq axis with pipe")
     if (D == 1 and n_data == 1 and T == 1 and n_seq == 1 and V == 1
             and not force_tick_executor):
         # Degenerate 1-stage pipeline == a plain full-batch train step: the
@@ -194,8 +190,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             if sp_axis is None:
                 return body_apply(cfg, layer_p, x, tp_axis=tp_axis, tp_size=T)
             # sequence-sharded stage: ring attention across the 'seq' axis
+            # (optionally Megatron head-sharded over 'model' as well)
             from .seq_parallel import sp_body_apply
-            return sp_body_apply(cfg, layer_p, x, sp_axis)
+            return sp_body_apply(cfg, layer_p, x, sp_axis,
+                                 tp_axis=tp_axis, tp_size=T)
 
         def stage_embed(embed_p, toks):
             if sp_axis is None:
@@ -234,6 +232,18 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 lambda: jnp.sum(y.astype(jnp.float32)
                                 * g_in.astype(jnp.float32)))
 
+        def run_unit(pred, unit, noop, operand):
+            """Execute one schedule unit. Dense meshes: a lax.cond (idle
+            devices take the cheap branch). Seq-sharded meshes: run the unit
+            unconditionally and where-mask its outputs against the noop's —
+            ppermute (flat-pair collective-permute) requires full
+            participation, so every seq peer must execute the unit's ring
+            collectives every tick (see docs/parallelism.md)."""
+            if sp_axis is None:
+                return jax.lax.cond(pred, unit, noop, operand)
+            return jax.tree.map(lambda n, o: jnp.where(pred, n, o),
+                                unit(operand), noop(operand))
+
         def tick(carry, row_all):
             (act_buf, grad_buf, fwd_recv, bwd_recv,
              g_layers, g_embed, g_head, loss_acc) = carry
@@ -259,18 +269,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             def fwd_noop(act_buf):
                 return act_buf, jnp.zeros(mb_shape, dtype)
 
-            if sp_axis is None:
-                act_buf, fwd_send = jax.lax.cond(fm >= 0, fwd_unit, fwd_noop,
-                                                 act_buf)
-            else:
-                # ring attention's ppermutes are flat-pair collectives: every
-                # device must execute them each tick, so run the unit
-                # unconditionally and mask its effects instead of cond-ing
-                # around it (see tests/test_sp_pipeline.py)
-                new_buf, y = fwd_unit(act_buf)
-                f_active = fm >= 0
-                act_buf = jnp.where(f_active, new_buf, act_buf)
-                fwd_send = jnp.where(f_active, y, jnp.zeros(mb_shape, dtype))
+            act_buf, fwd_send = run_unit(fm >= 0, fwd_unit, fwd_noop,
+                                         act_buf)
 
             # 3. backward unit (rematerializing)
             bv, bm = row[COL_BWD_V], row[COL_BWD_M]
@@ -295,15 +295,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 def dgrad_noop(loss_acc):
                     return loss_acc, jnp.zeros(mb_shape, dtype)
 
-                if sp_axis is None:
-                    loss_acc, bwd_send = jax.lax.cond(
-                        bm >= 0, dgrad_unit, dgrad_noop, loss_acc)
-                else:
-                    new_loss, gx = dgrad_unit(loss_acc)
-                    b_active = bm >= 0
-                    loss_acc = jnp.where(b_active, new_loss, loss_acc)
-                    bwd_send = jnp.where(b_active, gx,
-                                         jnp.zeros(mb_shape, dtype))
+                loss_acc, bwd_send = run_unit(bm >= 0, dgrad_unit,
+                                              dgrad_noop, loss_acc)
 
                 wv, wm = row[COL_W_V], row[COL_W_M]
 
@@ -334,15 +327,9 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                         lambda: g_embed)
                     return (g_layers, g_embed, g_head)
 
-                if sp_axis is None:
-                    (g_layers, g_embed, g_head) = jax.lax.cond(
-                        wm >= 0, wgrad_unit, lambda op: op,
-                        (g_layers, g_embed, g_head))
-                else:
-                    new_g = wgrad_unit((g_layers, g_embed, g_head))
-                    (g_layers, g_embed, g_head) = jax.tree.map(
-                        lambda new, old: jnp.where(wm >= 0, new, old),
-                        new_g, (g_layers, g_embed, g_head))
+                (g_layers, g_embed, g_head) = run_unit(
+                    wm >= 0, wgrad_unit, lambda op: op,
+                    (g_layers, g_embed, g_head))
 
                 fwd_recv = jax.lax.ppermute(fwd_send, PIPE_AXIS, fwd_perm)
                 bwd_recv = jax.lax.ppermute(bwd_send, PIPE_AXIS, bwd_perm)
@@ -379,17 +366,9 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             def bwd_noop(operand):
                 return operand, jnp.zeros(mb_shape, dtype)
 
-            if sp_axis is None:
-                (g_layers, g_embed, g_head, loss_acc), bwd_send = jax.lax.cond(
-                    bm >= 0, bwd_unit, bwd_noop,
-                    (g_layers, g_embed, g_head, loss_acc))
-            else:
-                new_state, gx = bwd_unit((g_layers, g_embed, g_head, loss_acc))
-                b_active = bm >= 0
-                (g_layers, g_embed, g_head, loss_acc) = jax.tree.map(
-                    lambda new, old: jnp.where(b_active, new, old),
-                    new_state, (g_layers, g_embed, g_head, loss_acc))
-                bwd_send = jnp.where(b_active, gx, jnp.zeros(mb_shape, dtype))
+            (g_layers, g_embed, g_head, loss_acc), bwd_send = run_unit(
+                bm >= 0, bwd_unit, bwd_noop,
+                (g_layers, g_embed, g_head, loss_acc))
 
             # 4. ring transfer: activations +1, gradients -1 (ICI hops)
             fwd_recv = jax.lax.ppermute(fwd_send, PIPE_AXIS, fwd_perm)

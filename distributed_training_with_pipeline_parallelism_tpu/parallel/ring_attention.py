@@ -86,17 +86,32 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
 
 def ring_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
                    n_heads: int, axis_name: str, causal: bool = False,
-                   rope_angles: Optional[jax.Array] = None) -> jax.Array:
+                   rope_angles: Optional[jax.Array] = None,
+                   tp_axis: Optional[str] = None) -> jax.Array:
     """Sequence-parallel drop-in for ``ops.attention.mha_apply``: projections
     are local (they are position-wise), attention runs over the ring.
 
     ``rope_angles`` must already be sliced to this device's global positions
-    (see :func:`local_rope_angles`).
+    (see :func:`local_rope_angles`). With ``tp_axis`` the projections are
+    additionally Megatron head-sharded over that axis (``n_heads`` = local
+    head count, weights = local shards), composing sequence and tensor
+    parallelism: the ring rotates this model-shard's K/V heads over 'seq'
+    within each model column.
     """
     b, s, _ = q_in.shape
+    if tp_axis is not None:
+        from ..ops.collectives import row_parallel_linear, tp_copy
+        if kv_in is q_in:  # self-attention: one copy, one backward psum
+            q_in = kv_in = tp_copy(q_in, tp_axis)
+        else:
+            q_in = tp_copy(q_in, tp_axis)
+            kv_in = tp_copy(kv_in, tp_axis)
     q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles)
     out = ring_attention(q, k, v, axis_name, causal=causal)
-    return linear_apply(params["o"], out.reshape(b, s, -1))
+    out = out.reshape(b, s, -1)
+    if tp_axis is not None:
+        return row_parallel_linear(params["o"], out, tp_axis)
+    return linear_apply(params["o"], out)
 
 
 def local_rope_angles(cfg, seq_local: int, axis_name: str) -> jax.Array:

@@ -16,7 +16,7 @@ pipeline executor.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,34 +36,51 @@ ATTN_IMPLS = {"ring": ring_mha_apply, "ulysses": ulysses_mha_apply}
 
 
 def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
-                   rope_angles, attn_impl: str = "ring") -> jax.Array:
-    """Sequence-sharded twin of ``models.transformer.layer_apply``."""
+                   rope_angles, attn_impl: str = "ring",
+                   tp_axis: Optional[str] = None, tp_size: int = 1) -> jax.Array:
+    """Sequence-sharded twin of ``models.transformer.layer_apply``.
+
+    With ``tp_axis`` the block is additionally Megatron tensor-parallel
+    (ring attention only): weight leaves are local model-axis shards, norms
+    replicated — the 4-D ``data x pipe x model x seq`` composition."""
+    from ..models.transformer import _ffn_out, _tp_in
+
+    if tp_axis is not None and attn_impl != "ring":
+        raise NotImplementedError(
+            "tensor parallelism composes with ring attention only (Ulysses "
+            "already shards heads over the seq axis)")
     sp_mha = ATTN_IMPLS[attn_impl]
+    heads = cfg.n_heads // tp_size
     if cfg.arch == "ref_decoder":
         mem = h
         x = layer_norm_apply(params["ln1"],
                              h + sp_mha(params["self_attn"], h, h,
-                                        cfg.n_heads, axis_name))
+                                        heads, axis_name, tp_axis=tp_axis))
         x = layer_norm_apply(params["ln2"],
                              x + sp_mha(params["cross_attn"], x, mem,
-                                        cfg.n_heads, axis_name))
-        ff = linear_apply(params["lin2"], jax.nn.relu(linear_apply(params["lin1"], x)))
+                                        heads, axis_name, tp_axis=tp_axis))
+        ff = _ffn_out(params["lin2"],
+                      jax.nn.relu(linear_apply(params["lin1"],
+                                               _tp_in(x, tp_axis))),
+                      tp_axis)
         return layer_norm_apply(params["ln3"], x + ff)
     if cfg.arch == "gpt2":
         a = layer_norm_apply(params["ln1"], h)
-        h = h + sp_mha(params["attn"], a, a, cfg.n_heads, axis_name,
-                       causal=True)
-        m = layer_norm_apply(params["ln2"], h)
-        return h + linear_apply(params["lin2"],
-                                jax.nn.gelu(linear_apply(params["lin1"], m)))
+        h = h + sp_mha(params["attn"], a, a, heads, axis_name,
+                       causal=True, tp_axis=tp_axis)
+        m = _tp_in(layer_norm_apply(params["ln2"], h), tp_axis)
+        return h + _ffn_out(params["lin2"],
+                            jax.nn.gelu(linear_apply(params["lin1"], m)),
+                            tp_axis)
     if cfg.arch == "llama":
         a = rms_norm_apply(params["rms1"], h, cfg.rms_eps)
-        h = h + sp_mha(params["attn"], a, a, cfg.n_heads, axis_name,
-                       causal=True, rope_angles=rope_angles)
-        m = rms_norm_apply(params["rms2"], h, cfg.rms_eps)
-        ff = linear_apply(params["w2"],
-                          jax.nn.silu(linear_apply(params["w1"], m))
-                          * linear_apply(params["w3"], m))
+        h = h + sp_mha(params["attn"], a, a, heads, axis_name,
+                       causal=True, rope_angles=rope_angles, tp_axis=tp_axis)
+        m = _tp_in(rms_norm_apply(params["rms2"], h, cfg.rms_eps), tp_axis)
+        ff = _ffn_out(params["w2"],
+                      jax.nn.silu(linear_apply(params["w1"], m))
+                      * linear_apply(params["w3"], m),
+                      tp_axis)
         return h + ff
     raise ValueError(f"unknown arch {cfg.arch!r}")
 
@@ -83,7 +100,8 @@ def sp_embed_apply(cfg: ModelConfig, embed, tokens: jax.Array,
 
 
 def sp_body_apply(cfg: ModelConfig, layers, h: jax.Array, axis_name: str,
-                  attn_impl: str = "ring") -> jax.Array:
+                  attn_impl: str = "ring", tp_axis: Optional[str] = None,
+                  tp_size: int = 1) -> jax.Array:
     """Sequence-sharded twin of ``models.transformer.body_apply``: scan the
     stacked layers with ring/Ulysses attention over ``axis_name``."""
     rope = (local_rope_angles(cfg, h.shape[1], axis_name)
@@ -91,7 +109,8 @@ def sp_body_apply(cfg: ModelConfig, layers, h: jax.Array, axis_name: str,
 
     def step(carry, layer_params):
         return sp_layer_apply(cfg, layer_params, carry, axis_name, rope,
-                              attn_impl=attn_impl), None
+                              attn_impl=attn_impl, tp_axis=tp_axis,
+                              tp_size=tp_size), None
 
     if cfg.remat_layers:
         step = jax.checkpoint(step)

@@ -71,15 +71,23 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def ulysses_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
                       n_heads: int, axis_name: str, causal: bool = False,
-                      rope_angles: Optional[jax.Array] = None) -> jax.Array:
+                      rope_angles: Optional[jax.Array] = None,
+                      tp_axis: Optional[str] = None) -> jax.Array:
     """Sequence-parallel drop-in for ``ops.attention.mha_apply`` (same
     signature as :func:`..ring_attention.ring_mha_apply`): projections are
     position-wise (local); the attention core re-shards via all-to-all.
+
+    ``tp_axis`` is accepted for signature parity with ``ring_mha_apply``
+    but tensor parallelism does not compose with Ulysses (heads are already
+    sharded over the seq axis) — callers must pass None.
 
     ``rope_angles`` must be pre-sliced to this device's global positions
     (``ring_attention.local_rope_angles``) — rotation happens *before* the
     head-scatter, while rows still sit at their global positions.
     """
+    if tp_axis is not None:
+        raise NotImplementedError(
+            "tensor parallelism does not compose with Ulysses attention")
     b, s, _ = q_in.shape
     q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles,
                           expand_gqa=False)  # expansion happens post-gather

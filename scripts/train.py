@@ -32,6 +32,10 @@ def main():
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel (model-axis) size; composes with "
                          "--pipe/--data into a 3-D mesh")
+    ap.add_argument("--sp", type=int, default=1,
+                    help="sequence-parallel (seq-axis) size: ring attention "
+                         "inside pipeline stages; composes with the other "
+                         "axes (4-D with --tp)")
     ap.add_argument("--virtual", type=int, default=1)
     ap.add_argument("--microbatches", type=int, default=4)
     ap.add_argument("--steps", type=int, default=50)
@@ -118,12 +122,14 @@ def main():
         overrides["ffn_dim"] = max(1, round(base.ffn_dim * args.dim / base.dim))
     cfg = build_cfg(**overrides)
 
-    mesh = make_mesh(n_pipe=args.pipe, n_data=args.data, n_model=args.tp)
+    mesh = make_mesh(n_pipe=args.pipe, n_data=args.data, n_model=args.tp,
+                     n_seq=args.sp)
     sched = dtpp.ScheduleConfig(name=args.schedule,
                                 n_microbatches=args.microbatches,
                                 n_virtual=args.virtual)
     print(f"model={args.model} {cfg.dim}d x {cfg.n_layers}L x {cfg.n_heads}H, "
-          f"mesh=(data={args.data}, pipe={args.pipe}, model={args.tp}), "
+          f"mesh=(data={args.data}, pipe={args.pipe}, model={args.tp}, "
+          f"seq={args.sp}), "
           f"{args.schedule} M={args.microbatches} V={args.virtual}", flush=True)
 
     optimizer = train.adamw(learning_rate=args.lr, total_steps=args.steps)

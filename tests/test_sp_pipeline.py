@@ -71,13 +71,14 @@ def test_sp_with_virtual_stages():
     _check(step, *prob)
 
 
-def test_tp_and_sp_together_rejected():
+def test_tp_and_sp_together_gpipe():
     cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
                            ffn_dim=64)
+    prob = _problem(cfg)
     mesh = make_mesh(n_pipe=2, n_model=2, n_seq=2)
-    with pytest.raises(NotImplementedError, match="not yet composed"):
-        make_pipeline_step(cfg, mesh, dtpp.ScheduleConfig(name="GPipe",
-                                                          n_microbatches=2))
+    step = make_pipeline_step(cfg, mesh, dtpp.ScheduleConfig(name="GPipe",
+                                                             n_microbatches=2))
+    _check(step, *prob)
 
 
 def test_sp_with_zero_bubble_schedule():
@@ -88,3 +89,24 @@ def test_sp_with_zero_bubble_schedule():
     step = make_pipeline_step(
         cfg, mesh, dtpp.ScheduleConfig(name="ZBH1", n_microbatches=4))
     _check(step, *prob)
+
+
+def test_4d_dp_pp_tp_sp():
+    """The full composition: data x pipe x model x seq in one step (8 devs
+    would need 16 for data=2, so data=1 here: pipe=2 x model=2 x seq=2)."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=32, arch="llama",
+                           n_kv_heads=2)
+    prob = _problem(cfg)
+    mesh = make_mesh(n_pipe=2, n_model=2, n_seq=2)
+    step = make_pipeline_step(
+        cfg, mesh, dtpp.ScheduleConfig(name="1F1B", n_microbatches=2))
+    _check(step, *prob)
+
+
+def test_tp_sp_rejected_for_ulysses():
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.ulysses import (
+        ulysses_mha_apply)
+    with pytest.raises(NotImplementedError, match="Ulysses"):
+        ulysses_mha_apply({}, jnp.zeros((1, 4, 8)), jnp.zeros((1, 4, 8)),
+                          2, "seq", tp_axis="model")
