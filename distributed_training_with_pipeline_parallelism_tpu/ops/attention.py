@@ -116,13 +116,8 @@ def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
     are replicated (``tp_copy`` marks them so input cotangents sum), and
     the output projection is row-parallel (``tp_reduce`` completes it).
     """
-    if tp_axis is not None:
-        from .collectives import row_parallel_linear, tp_copy
-        if kv_in is q_in:  # self-attention: one copy, one backward psum
-            q_in = kv_in = tp_copy(q_in, tp_axis)
-        else:
-            q_in = tp_copy(q_in, tp_axis)
-            kv_in = tp_copy(kv_in, tp_axis)
+    from .collectives import tp_attention_inputs, tp_output_projection
+    q_in, kv_in = tp_attention_inputs(q_in, kv_in, tp_axis)
     q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles)
     if flash:
         from .pallas_attention import flash_attention
@@ -134,6 +129,4 @@ def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
             mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None]
         out = scaled_dot_attention(q, k, v, mask)
     out = out.reshape(q_in.shape[0], q_in.shape[1], -1)
-    if tp_axis is not None:
-        return row_parallel_linear(params["o"], out, tp_axis)
-    return linear_apply(params["o"], out)
+    return tp_output_projection(params["o"], out, tp_axis)

@@ -60,3 +60,26 @@ def row_parallel_linear(params, x: jax.Array, axis_name: str) -> jax.Array:
     if "b" in params:
         y = y + params["b"]
     return y
+
+
+def tp_attention_inputs(q_in, kv_in, tp_axis):
+    """Megatron TP prologue shared by the dense and ring attention paths:
+    mark replicated inputs entering column-parallel projections. For
+    self-attention (same array) one copy suffices — one backward psum."""
+    if tp_axis is None:
+        return q_in, kv_in
+    if kv_in is q_in:
+        q_in = kv_in = tp_copy(q_in, tp_axis)
+    else:
+        q_in = tp_copy(q_in, tp_axis)
+        kv_in = tp_copy(kv_in, tp_axis)
+    return q_in, kv_in
+
+
+def tp_output_projection(o_params, out, tp_axis):
+    """Megatron TP epilogue shared by the dense and ring attention paths:
+    plain linear when unsharded, row-parallel (psum + bias-once) under TP."""
+    if tp_axis is None:
+        from .layers import linear_apply
+        return linear_apply(o_params, out)
+    return row_parallel_linear(o_params, out, tp_axis)

@@ -42,10 +42,12 @@ def make_ep_mesh(n_expert: int, devices=None) -> "Mesh":
 
 
 def make_mesh(n_pipe: int, n_data: int = 1, n_model: int = 1, n_seq: int = 1,
+              n_expert: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build the pipeline mesh: ('data', 'pipe'), growing a 'model' axis
-    (tensor parallelism inside stages) and/or a 'seq' axis (ring-attention
-    sequence parallelism inside stages) when those sizes exceed 1. Extra
+    (tensor parallelism inside stages), a 'seq' axis (ring-attention
+    sequence parallelism inside stages), and/or an 'expert' axis (MoE
+    expert parallelism inside stages) when those sizes exceed 1. Extra
     axes are innermost — the highest-traffic collectives ride the shortest
     ICI hops."""
     devices = list(devices if devices is not None else jax.devices())
@@ -54,6 +56,8 @@ def make_mesh(n_pipe: int, n_data: int = 1, n_model: int = 1, n_seq: int = 1,
         sizes.append(("n_model", MODEL_AXIS, n_model))
     if n_seq > 1:
         sizes.append(("n_seq", SEQ_AXIS, n_seq))
+    if n_expert > 1:
+        sizes.append(("n_expert", EXPERT_AXIS, n_expert))
     need = int(np.prod([n for _, _, n in sizes]))
     if len(devices) < need:
         detail = ", ".join(f"{name[2:]}={n}" for name, _, n in sizes)

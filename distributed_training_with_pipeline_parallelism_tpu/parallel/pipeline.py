@@ -48,7 +48,8 @@ from ..models.transformer import (body_apply, embed_apply, head_apply,
                                   transformer_loss)
 from ..ops.layers import select_xent
 from ..utils.config import ModelConfig, ScheduleConfig
-from .mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
+from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS,
+                   SEQ_AXIS)
 from .schedules import (COL_BWD_ASLOT, COL_BWD_GSLOT, COL_BWD_M, COL_BWD_V,
                         COL_FWD_M, COL_FWD_SLOT, COL_FWD_V, COL_STORE_B_SLOT,
                         COL_STORE_F_SLOT, COL_W_ASLOT, COL_W_GSLOT, COL_W_M,
@@ -119,15 +120,18 @@ def unstack_stage_layers(stacked: Pytree) -> Pytree:
 
 
 def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
-                          force_tick_executor: bool = False,
+                          force_tick_executor: bool = False, moe=None,
                           ) -> Callable[[Pytree, jax.Array, jax.Array],
                                         Tuple[jax.Array, Pytree]]:
     """Build an (unjitted) ``(params, tokens, targets) -> (loss, grads)``
     pipeline step — compose with an optimizer under one jit (see
     :mod:`..utils.train`) or jit directly via :func:`make_pipeline_step`.
 
-    ``params`` is the full-model pytree from ``transformer_init``; ``grads``
-    comes back in the same layout. ``tokens``/``targets`` are ``[B, S]`` with
+    ``params`` is the full-model pytree from ``transformer_init`` (or
+    ``moe_lm_init`` when ``moe`` — a :class:`..models.moe.MoEConfig` — is
+    given: stages then run MoE blocks, experts sharded over an 'expert'
+    mesh axis when present, and the loss gains the routing aux term,
+    microbatch-averaged). ``grads`` comes back in the same layout. ``tokens``/``targets`` are ``[B, S]`` with
     ``B`` divisible by (n_data * n_microbatches); the batch is split over the
     'data' mesh axis, then into microbatches along dim 0 (upstream
     ``DEFAULT_CHUNK_DIM=0``, ``microbatch.py:57``).
@@ -136,6 +140,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     n_data = mesh.shape.get(DATA_AXIS, 1)
     T = mesh.shape.get(MODEL_AXIS, 1)
     n_seq = mesh.shape.get(SEQ_AXIS, 1)
+    n_ep = mesh.shape.get(EXPERT_AXIS, 1)
     V = sched.n_virtual
     M = sched.n_microbatches
     cs: CompiledSchedule = _compile(sched.name, D, V, M)
@@ -148,8 +153,22 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 f"tensor parallelism needs n_heads ({cfg.n_heads}), "
                 f"n_kv_heads ({n_kv}) and ffn_dim ({cfg.ffn_dim}) divisible "
                 f"by the model-axis size {T}")
+    ep_axis = EXPERT_AXIS if n_ep > 1 else None
+    if n_ep > 1 and moe is None:
+        raise ValueError("mesh has an 'expert' axis but no MoEConfig given")
+    if moe is not None:
+        if T > 1 or n_seq > 1:
+            raise NotImplementedError(
+                "MoE pipeline composes with data/pipe/expert axes; "
+                "model/seq axes are not supported with MoE stages")
+        if cfg.arch != "gpt2":
+            raise ValueError("MoE pipeline blocks are gpt2-style; set "
+                             "arch='gpt2'")
+        if moe.n_experts % n_ep:
+            raise ValueError(f"n_experts={moe.n_experts} must divide over "
+                             f"{n_ep} expert shards")
     if (D == 1 and n_data == 1 and T == 1 and n_seq == 1 and V == 1
-            and not force_tick_executor):
+            and moe is None and not force_tick_executor):
         # Degenerate 1-stage pipeline == a plain full-batch train step: the
         # microbatch-accumulated, 1/M-scaled loss/grads equal the full-batch
         # mean exactly (asserted in tests/test_pipeline.py), so skip the tick
@@ -187,13 +206,29 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         mb_shape = (mb, seq, cfg.dim)
 
         def stage_body(layer_p, x):
+            """-> (y, aux): aux is the stage's summed routing load-balance
+            loss (MoE stages), else a constant 0 that XLA eliminates."""
+            zero = jnp.zeros((), jnp.float32)
+            if moe is not None:
+                from ..models.moe import moe_layer_apply
+
+                def mstep(carry, lp):
+                    h, aux = carry
+                    h, a = moe_layer_apply(cfg, moe, lp, h, ep_axis)
+                    return (h, aux + a), None
+
+                if cfg.remat_layers:
+                    mstep = jax.checkpoint(mstep)
+                (y, aux), _ = jax.lax.scan(mstep, (x, zero), layer_p)
+                return y, aux
             if sp_axis is None:
-                return body_apply(cfg, layer_p, x, tp_axis=tp_axis, tp_size=T)
+                return (body_apply(cfg, layer_p, x, tp_axis=tp_axis,
+                                   tp_size=T), zero)
             # sequence-sharded stage: ring attention across the 'seq' axis
             # (optionally Megatron head-sharded over 'model' as well)
             from .seq_parallel import sp_body_apply
-            return sp_body_apply(cfg, layer_p, x, sp_axis,
-                                 tp_axis=tp_axis, tp_size=T)
+            return (sp_body_apply(cfg, layer_p, x, sp_axis,
+                                  tp_axis=tp_axis, tp_size=T), zero)
 
         def stage_embed(embed_p, toks):
             if sp_axis is None:
@@ -212,25 +247,33 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             new = jnp.where(active, reg, buf[ss])
             return buf.at[ss].set(new)
 
+        # Every device's objective is its local share; the shards' implicit
+        # SPMD sum is the global mean, so no collective sits inside the
+        # objective. The reported loss is psum'd once, outside the schedule.
+        loss_norm = n_seq * n_ep
+        aux_scale = (moe.aux_loss_weight / cfg.n_layers / loss_norm
+                     if moe is not None else 0.0)
+
         def stage_objective(p_v, head_p, x_in, mm, last_stage, g_in):
-            """The scalar whose gradients are the stage VJP: the real loss
-            through the head on the last stage, else the contraction of the
-            stage output with the incoming cotangent."""
-            y = stage_body(p_v, x_in)
+            """-> (objective, loss_report). The objective's gradients are the
+            stage VJP: the real loss through the head on the last stage, else
+            the contraction of the stage output with the incoming cotangent —
+            plus this stage's share of the MoE routing aux loss. loss_report
+            is what the tick accumulates into the reported loss."""
+            y, aux = stage_body(p_v, x_in)
 
             def loss_branch():
                 local = select_xent(cfg.use_fused_xent)(
                     head_apply(cfg, head_p, y), targets_mb[mm])
-                # seq-sharded: each shard's objective is its local-mean/n_seq
-                # share; the shards' implicit SPMD sum IS the global token
-                # mean, so AD needs no collective here. The reported loss is
-                # psum'd over 'seq' once, outside the schedule (below).
-                return local if sp_axis is None else local / n_seq
+                return local / loss_norm
 
-            return jax.lax.cond(
+            main = jax.lax.cond(
                 last_stage, loss_branch,
                 lambda: jnp.sum(y.astype(jnp.float32)
                                 * g_in.astype(jnp.float32)))
+            aux_term = aux * aux_scale
+            report = jnp.where(last_stage, main, 0.0) + aux_term
+            return main + aux_term, report
 
         def run_unit(pred, unit, noop, operand):
             """Execute one schedule unit. Dense meshes: a lax.cond (idle
@@ -263,7 +306,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 x_emb = stage_embed(embed, tokens_mb[mm]).astype(dtype)
                 x = jnp.where(first_stage, x_emb, act_buf[ss])
                 act_buf = act_buf.at[ss].set(x)  # saved for remat backward
-                y = stage_body(select_v(layers_local, vv), x)
+                y, _ = stage_body(select_v(layers_local, vv), x)
                 return act_buf, y
 
             def fwd_noop(act_buf):
@@ -287,10 +330,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     x = act_buf[jnp.maximum(row[COL_BWD_ASLOT], 0)]
                     g_in = grad_buf[jnp.maximum(row[COL_BWD_GSLOT], 0)]
                     params_v = select_v(layers_local, vv)
-                    loss_val, gx = jax.value_and_grad(
+                    (_, report), gx = jax.value_and_grad(
                         lambda x_in: stage_objective(params_v, head, x_in, mm,
-                                                     last_stage, g_in))(x)
-                    return loss_acc + jnp.where(last_stage, loss_val, 0.0), gx
+                                                     last_stage, g_in),
+                        has_aux=True)(x)
+                    return loss_acc + report, gx
 
                 def dgrad_noop(loss_acc):
                     return loss_acc, jnp.zeros(mb_shape, dtype)
@@ -308,10 +352,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     x_slot = act_buf[jnp.maximum(row[COL_W_ASLOT], 0)]
                     g_in = grad_buf[jnp.maximum(row[COL_W_GSLOT], 0)]
                     params_v = select_v(layers_local, vv)
-                    gp, gh, gx = jax.grad(
+                    (gp, gh, gx), _ = jax.grad(
                         lambda p_v, head_p, x_in: stage_objective(
                             p_v, head_p, x_in, mm, last_stage, g_in),
-                        argnums=(0, 1, 2))(params_v, head, x_slot)
+                        argnums=(0, 1, 2), has_aux=True)(params_v, head, x_slot)
                     g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
                                             g_layers, gp)
                     g_head = jax.tree.map(jnp.add, g_head, gh)
@@ -344,10 +388,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 x = act_buf[jnp.maximum(row[COL_BWD_ASLOT], 0)]
                 g_in = grad_buf[jnp.maximum(row[COL_BWD_GSLOT], 0)]
                 params_v = select_v(layers_local, vv)
-                loss_val, (gp, gh, gx) = jax.value_and_grad(
+                (_, report), (gp, gh, gx) = jax.value_and_grad(
                     lambda p_v, head_p, x_in: stage_objective(
                         p_v, head_p, x_in, mm, last_stage, g_in),
-                    argnums=(0, 1, 2))(params_v, head, x)
+                    argnums=(0, 1, 2), has_aux=True)(params_v, head, x)
 
                 g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
                                         g_layers, gp)
@@ -360,7 +404,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                             stage_embed(e, tokens_mb[mm]).astype(jnp.float32),
                             gx.astype(jnp.float32)))(embed)),
                     lambda: g_embed)
-                loss_acc = loss_acc + jnp.where(last_stage, loss_val, 0.0)
+                loss_acc = loss_acc + report
                 return (g_layers, g_embed, g_head, loss_acc), gx
 
             def bwd_noop(operand):
@@ -398,6 +442,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         if n_seq > 1:
             # each shard accumulated local_mean/n_seq -> sum = global mean
             loss = jax.lax.psum(loss, SEQ_AXIS)
+        if n_ep > 1:
+            loss = jax.lax.psum(loss, EXPERT_AXIS)
         g_layers = jax.tree.map(lambda x: x[None] * inv, g_layers)
         g_embed = jax.tree.map(lambda x: jax.lax.psum(x * inv, PIPE_AXIS), g_embed)
         g_head = jax.tree.map(lambda x: jax.lax.psum(x * inv, PIPE_AXIS), g_head)
@@ -414,6 +460,25 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             g_layers, g_embed, g_head = jax.tree.map(
                 lambda x: jax.lax.psum(x, SEQ_AXIS),
                 (g_layers, g_embed, g_head))
+        if n_ep > 1:
+            # 'expert' doubles as a batch axis: replicated params sum their
+            # per-shard local contributions; expert-sharded stacks (the
+            # w1/b1/w2/b2 leaves under "moe") are already complete per shard
+            # (every token reached its expert via the all_to_all), so they
+            # stay local
+            from jax.tree_util import DictKey
+
+            from .expert_parallel import _EXPERT_LEAVES
+
+            def ep_reduce(path, g):
+                keys = [k.key for k in path if isinstance(k, DictKey)]
+                if "moe" in keys and keys[-1] in _EXPERT_LEAVES:
+                    return g
+                return jax.lax.psum(g, EXPERT_AXIS)
+
+            g_layers = jax.tree_util.tree_map_with_path(ep_reduce, g_layers)
+            g_embed, g_head = jax.tree.map(
+                lambda x: jax.lax.psum(x, EXPERT_AXIS), (g_embed, g_head))
         return loss, g_layers, g_embed, g_head
 
     if T > 1:
@@ -423,9 +488,26 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         # local shards and n_heads/T local heads.
         from .tensor_parallel import pipeline_layer_specs
         layer_spec = pipeline_layer_specs(cfg, PIPE_AXIS)
+    elif moe is not None:
+        # Stacked MoE layer layout [D, V, lps, ...]: expert stacks (leading
+        # expert dim = axis 3) sharded over 'expert', everything else only
+        # over 'pipe'.
+        ln = {"scale": P(PIPE_AXIS), "bias": P(PIPE_AXIS)}
+        lin = {"w": P(PIPE_AXIS), "b": P(PIPE_AXIS)}
+        exp = (P(PIPE_AXIS, None, None, EXPERT_AXIS) if n_ep > 1
+               else P(PIPE_AXIS))
+        layer_spec = {"ln1": ln, "ln2": ln,
+                      "attn": {"q": lin, "k": lin, "v": lin, "o": lin},
+                      "moe": {"router": {"w": P(PIPE_AXIS)},
+                              "w1": exp, "b1": exp, "w2": exp, "b2": exp}}
     else:
         layer_spec = P(PIPE_AXIS)
-    batch_spec = P(DATA_AXIS, SEQ_AXIS) if n_seq > 1 else P(DATA_AXIS)
+    if n_seq > 1:
+        batch_spec = P(DATA_AXIS, SEQ_AXIS)
+    elif n_ep > 1:
+        batch_spec = P((DATA_AXIS, EXPERT_AXIS))  # batch over data x expert
+    else:
+        batch_spec = P(DATA_AXIS)
     sharded = _shard_map(
         spmd_fn, mesh,
         in_specs=(layer_spec, P(), P(), batch_spec, batch_spec),
@@ -447,7 +529,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
 
 def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
-                       force_tick_executor: bool = False,
+                       force_tick_executor: bool = False, moe=None,
                        ) -> Callable[[Pytree, jax.Array, jax.Array],
                                      Tuple[jax.Array, Pytree]]:
     """Jitted ``(params, tokens, targets) -> (loss, grads)`` pipeline step.
@@ -458,5 +540,5 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     tick program even in the degenerate 1-device case (used by bubble
     measurement, where the comparator must pay the same remat cost).
     """
-    return jax.jit(make_pipeline_grad_fn(cfg, mesh, sched,
-                                         force_tick_executor=force_tick_executor))
+    return jax.jit(make_pipeline_grad_fn(
+        cfg, mesh, sched, force_tick_executor=force_tick_executor, moe=moe))

@@ -98,20 +98,12 @@ def ring_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
     parallelism: the ring rotates this model-shard's K/V heads over 'seq'
     within each model column.
     """
+    from ..ops.collectives import tp_attention_inputs, tp_output_projection
     b, s, _ = q_in.shape
-    if tp_axis is not None:
-        from ..ops.collectives import row_parallel_linear, tp_copy
-        if kv_in is q_in:  # self-attention: one copy, one backward psum
-            q_in = kv_in = tp_copy(q_in, tp_axis)
-        else:
-            q_in = tp_copy(q_in, tp_axis)
-            kv_in = tp_copy(kv_in, tp_axis)
+    q_in, kv_in = tp_attention_inputs(q_in, kv_in, tp_axis)
     q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles)
     out = ring_attention(q, k, v, axis_name, causal=causal)
-    out = out.reshape(b, s, -1)
-    if tp_axis is not None:
-        return row_parallel_linear(params["o"], out, tp_axis)
-    return linear_apply(params["o"], out)
+    return tp_output_projection(params["o"], out.reshape(b, s, -1), tp_axis)
 
 
 def local_rope_angles(cfg, seq_local: int, axis_name: str) -> jax.Array:

@@ -1,0 +1,153 @@
+"""Pipelined Mixture-of-Experts (pp x ep): MoE blocks inside pipeline
+stages, experts sharded over an 'expert' mesh axis with all_to_all
+dispatch.
+
+Oracle: the microbatch-averaged MoE loss (capacity and routing statistics
+are per-microbatch in a pipeline, so the comparison target is
+mean-over-microbatches of moe_lm_loss, not the full-batch loss).
+"""
+
+import dataclasses
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.models.moe import (
+    MoEConfig, moe_lm_init, moe_lm_loss)
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import make_mesh
+from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+    make_pipeline_step)
+
+CFG = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                       ffn_dim=64, max_seq_len=16, arch="gpt2")
+
+
+def _problem(moe, M, seed=0, batch=8, seq=8):
+    params = moe_lm_init(jax.random.key(seed), CFG, moe)
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                CFG.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (batch, seq), 0,
+                                 CFG.vocab_size)
+
+    def microbatched_loss(p):
+        toks = tokens.reshape(M, -1, seq)
+        tgts = targets.reshape(M, -1, seq)
+        losses = [moe_lm_loss(CFG, moe, p, toks[m], tgts[m])
+                  for m in range(M)]
+        return sum(losses) / M
+
+    ref_loss, ref_grads = jax.value_and_grad(microbatched_loss)(params)
+    return params, tokens, targets, ref_loss, ref_grads
+
+
+def _check(step, params, tokens, targets, ref_loss, ref_grads, tol=2e-5):
+    loss, grads = step(params, tokens, targets)
+    assert float(jnp.abs(loss - ref_loss)) < tol, (float(loss), float(ref_loss))
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                       grads, ref_grads)
+    worst = max(jax.tree.leaves(err))
+    assert worst < tol, f"max grad err {worst}"
+
+
+@pytest.mark.parametrize("name", ["GPipe", "1F1B"])
+def test_moe_pipeline_matches_microbatched_oracle(name):
+    """pp only (no expert axis), aux loss ON: exact vs the oracle."""
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=4.0,
+                    aux_loss_weight=0.01)
+    prob = _problem(moe, M=4)
+    mesh = make_mesh(n_pipe=2)
+    step = make_pipeline_step(CFG, mesh,
+                              dtpp.ScheduleConfig(name=name, n_microbatches=4),
+                              moe=moe)
+    _check(step, *prob)
+
+
+def test_moe_pipeline_expert_parallel():
+    """pp x ep: experts sharded 4-way. Zero-drop capacity and local-vs-
+    global routing stats equal (aux off) -> exact vs the oracle."""
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=4.0,
+                    aux_loss_weight=0.0)
+    prob = _problem(moe, M=2)
+    mesh = make_mesh(n_pipe=2, n_expert=4)
+    step = make_pipeline_step(CFG, mesh,
+                              dtpp.ScheduleConfig(name="1F1B",
+                                                  n_microbatches=2),
+                              moe=moe)
+    _check(step, *prob)
+
+
+def test_moe_pipeline_dp_ep():
+    moe = MoEConfig(n_experts=4, top_k=1, capacity_factor=4.0,
+                    aux_loss_weight=0.0)
+    prob = _problem(moe, M=2)
+    mesh = make_mesh(n_pipe=2, n_data=2, n_expert=2)
+    step = make_pipeline_step(CFG, mesh,
+                              dtpp.ScheduleConfig(name="GPipe",
+                                                  n_microbatches=2),
+                              moe=moe)
+    _check(step, *prob)
+
+
+def test_moe_pipeline_interleaved_virtual():
+    moe = MoEConfig(n_experts=2, top_k=1, capacity_factor=2.0,
+                    aux_loss_weight=0.01)
+    prob = _problem(moe, M=2)
+    mesh = make_mesh(n_pipe=2)
+    step = make_pipeline_step(CFG, mesh,
+                              dtpp.ScheduleConfig(name="Interleaved1F1B",
+                                                  n_microbatches=2,
+                                                  n_virtual=2),
+                              moe=moe)
+    _check(step, *prob)
+
+
+def test_moe_rejects_bad_configs():
+    moe = MoEConfig(n_experts=3)
+    mesh = make_mesh(n_pipe=2, n_expert=2)
+    with pytest.raises(ValueError, match="divide over"):
+        make_pipeline_step(CFG, mesh, dtpp.ScheduleConfig(name="GPipe",
+                                                          n_microbatches=2),
+                           moe=moe)
+    with pytest.raises(ValueError, match="expert.*axis|MoEConfig"):
+        make_pipeline_step(CFG, mesh, dtpp.ScheduleConfig(name="GPipe",
+                                                          n_microbatches=2))
+    llama_cfg = dataclasses.replace(CFG, arch="llama")
+    with pytest.raises(ValueError, match="gpt2"):
+        make_pipeline_step(llama_cfg, make_mesh(n_pipe=2),
+                           dtpp.ScheduleConfig(name="GPipe",
+                                               n_microbatches=2),
+                           moe=MoEConfig(n_experts=4))
+
+
+def test_moe_pipeline_expert_parallel_aux_on():
+    """pp x ep with the routing aux loss LIVE: oracle = mean over
+    (expert-shard, microbatch) chunks of the full-model loss — under ep the
+    routing statistics are per-shard, and each chunk's all_to_all-dispatched
+    computation equals the unsharded computation of that chunk (zero
+    drops)."""
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=4.0,
+                    aux_loss_weight=0.05)
+    n_ep, M, batch, seq = 4, 2, 8, 8
+    params = moe_lm_init(jax.random.key(0), CFG, moe)
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                CFG.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (batch, seq), 0,
+                                 CFG.vocab_size)
+
+    def chunked_loss(p):
+        toks = tokens.reshape(n_ep, M, -1, seq)
+        tgts = targets.reshape(n_ep, M, -1, seq)
+        losses = [moe_lm_loss(CFG, moe, p, toks[s, m], tgts[s, m])
+                  for s in range(n_ep) for m in range(M)]
+        return sum(losses) / len(losses)
+
+    ref_loss, ref_grads = jax.value_and_grad(chunked_loss)(params)
+    mesh = make_mesh(n_pipe=2, n_expert=n_ep)
+    step = make_pipeline_step(CFG, mesh,
+                              dtpp.ScheduleConfig(name="GPipe",
+                                                  n_microbatches=M),
+                              moe=moe)
+    _check(step, params, tokens, targets, ref_loss, ref_grads)
