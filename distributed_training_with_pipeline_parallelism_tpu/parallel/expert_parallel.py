@@ -38,16 +38,23 @@ Pytree = Any
 _EXPERT_LEAVES = frozenset({"w1", "b1", "w2", "b2"})
 
 
+def is_expert_leaf(path) -> bool:
+    """True iff a pytree path addresses an expert-sharded stack (a leaf
+    under a "moe" subtree whose name is one of the expert weight/bias
+    stacks). The single source of truth for EP sharding decisions — used
+    by :func:`ep_param_specs` and the pipeline executor's spec builder and
+    gradient reduction."""
+    keys = [k.key for k in path if isinstance(k, DictKey)]
+    return bool(keys) and "moe" in keys and keys[-1] in _EXPERT_LEAVES
+
+
 def ep_param_specs(params: Pytree) -> Pytree:
     """PartitionSpec tree for a MoE LM pytree: expert stacks are sharded on
     their expert dim (axis 1 — axis 0 is the layer stack), everything else
     replicated."""
 
     def spec(path, _leaf):
-        keys = [k.key for k in path if isinstance(k, DictKey)]
-        if "moe" in keys and keys[-1] in _EXPERT_LEAVES:
-            return P(None, EXPERT_AXIS)
-        return P()
+        return P(None, EXPERT_AXIS) if is_expert_leaf(path) else P()
 
     return jax.tree_util.tree_map_with_path(spec, params)
 

@@ -121,6 +121,7 @@ def unstack_stage_layers(stacked: Pytree) -> Pytree:
 
 def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                           force_tick_executor: bool = False, moe=None,
+                          sp_attn_impl: str = "ring",
                           ) -> Callable[[Pytree, jax.Array, jax.Array],
                                         Tuple[jax.Array, Pytree]]:
     """Build an (unjitted) ``(params, tokens, targets) -> (loss, grads)``
@@ -146,6 +147,13 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     cs: CompiledSchedule = _compile(sched.name, D, V, M)
     tp_axis = MODEL_AXIS if T > 1 else None
     sp_axis = SEQ_AXIS if n_seq > 1 else None
+    if sp_attn_impl not in ("ring", "ulysses"):
+        raise ValueError(f"sp_attn_impl must be 'ring' or 'ulysses', "
+                         f"got {sp_attn_impl!r}")
+    # Only ring attention puts a ppermute (flat-pair collective) inside the
+    # schedule units; Ulysses' all_to_all is grouped, so its units may keep
+    # the efficient cond dispatch.
+    uniform_units = sp_axis is not None and sp_attn_impl == "ring"
     if T > 1:
         n_kv = cfg.n_kv_heads or cfg.n_heads
         if cfg.n_heads % T or n_kv % T or cfg.ffn_dim % T:
@@ -224,10 +232,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             if sp_axis is None:
                 return (body_apply(cfg, layer_p, x, tp_axis=tp_axis,
                                    tp_size=T), zero)
-            # sequence-sharded stage: ring attention across the 'seq' axis
-            # (optionally Megatron head-sharded over 'model' as well)
+            # sequence-sharded stage: ring/Ulysses attention across 'seq'
+            # (ring optionally Megatron head-sharded over 'model' as well)
             from .seq_parallel import sp_body_apply
             return (sp_body_apply(cfg, layer_p, x, sp_axis,
+                                  attn_impl=sp_attn_impl,
                                   tp_axis=tp_axis, tp_size=T), zero)
 
         def stage_embed(embed_p, toks):
@@ -276,13 +285,14 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             return main + aux_term, report
 
         def run_unit(pred, unit, noop, operand):
-            """Execute one schedule unit. Dense meshes: a lax.cond (idle
-            devices take the cheap branch). Seq-sharded meshes: run the unit
-            unconditionally and where-mask its outputs against the noop's —
-            ppermute (flat-pair collective-permute) requires full
+            """Execute one schedule unit. Default: a lax.cond (idle devices
+            take the cheap branch; psum/all_to_all inside are grouped, so a
+            group that skips together is fine). Ring-attention stages: run
+            the unit unconditionally and where-mask its outputs against the
+            noop's — ppermute (flat-pair collective-permute) requires full
             participation, so every seq peer must execute the unit's ring
             collectives every tick (see docs/parallelism.md)."""
-            if sp_axis is None:
+            if not uniform_units:
                 return jax.lax.cond(pred, unit, noop, operand)
             return jax.tree.map(lambda n, o: jnp.where(pred, n, o),
                                 unit(operand), noop(operand))
@@ -466,15 +476,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             # w1/b1/w2/b2 leaves under "moe") are already complete per shard
             # (every token reached its expert via the all_to_all), so they
             # stay local
-            from jax.tree_util import DictKey
-
-            from .expert_parallel import _EXPERT_LEAVES
+            from .expert_parallel import is_expert_leaf
 
             def ep_reduce(path, g):
-                keys = [k.key for k in path if isinstance(k, DictKey)]
-                if "moe" in keys and keys[-1] in _EXPERT_LEAVES:
-                    return g
-                return jax.lax.psum(g, EXPERT_AXIS)
+                return g if is_expert_leaf(path) else \
+                    jax.lax.psum(g, EXPERT_AXIS)
 
             g_layers = jax.tree_util.tree_map_with_path(ep_reduce, g_layers)
             g_embed, g_head = jax.tree.map(
@@ -491,15 +497,17 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     elif moe is not None:
         # Stacked MoE layer layout [D, V, lps, ...]: expert stacks (leading
         # expert dim = axis 3) sharded over 'expert', everything else only
-        # over 'pipe'.
-        ln = {"scale": P(PIPE_AXIS), "bias": P(PIPE_AXIS)}
-        lin = {"w": P(PIPE_AXIS), "b": P(PIPE_AXIS)}
-        exp = (P(PIPE_AXIS, None, None, EXPERT_AXIS) if n_ep > 1
-               else P(PIPE_AXIS))
-        layer_spec = {"ln1": ln, "ln2": ln,
-                      "attn": {"q": lin, "k": lin, "v": lin, "o": lin},
-                      "moe": {"router": {"w": P(PIPE_AXIS)},
-                              "w1": exp, "b1": exp, "w2": exp, "b2": exp}}
+        # over 'pipe'. Specs are derived per-leaf from the real layer tree
+        # (eval_shape: no arrays materialize) via the shared EP predicate.
+        from ..models.moe import moe_layer_init
+        from .expert_parallel import is_expert_leaf
+        template = jax.eval_shape(
+            lambda: moe_layer_init(jax.random.key(0), cfg, moe))
+        layer_spec = jax.tree_util.tree_map_with_path(
+            lambda path, _: (P(PIPE_AXIS, None, None, EXPERT_AXIS)
+                             if n_ep > 1 and is_expert_leaf(path)
+                             else P(PIPE_AXIS)),
+            template)
     else:
         layer_spec = P(PIPE_AXIS)
     if n_seq > 1:
@@ -530,6 +538,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
 def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                        force_tick_executor: bool = False, moe=None,
+                       sp_attn_impl: str = "ring",
                        ) -> Callable[[Pytree, jax.Array, jax.Array],
                                      Tuple[jax.Array, Pytree]]:
     """Jitted ``(params, tokens, targets) -> (loss, grads)`` pipeline step.
@@ -541,4 +550,5 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     measurement, where the comparator must pay the same remat cost).
     """
     return jax.jit(make_pipeline_grad_fn(
-        cfg, mesh, sched, force_tick_executor=force_tick_executor, moe=moe))
+        cfg, mesh, sched, force_tick_executor=force_tick_executor, moe=moe,
+        sp_attn_impl=sp_attn_impl))

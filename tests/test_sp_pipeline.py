@@ -110,3 +110,32 @@ def test_tp_sp_rejected_for_ulysses():
     with pytest.raises(NotImplementedError, match="Ulysses"):
         ulysses_mha_apply({}, jnp.zeros((1, 4, 8)), jnp.zeros((1, 4, 8)),
                           2, "seq", tp_axis="model")
+
+
+@pytest.mark.parametrize("arch,kw", [
+    ("gpt2", {}),
+    ("llama", dict(n_heads=8, n_kv_heads=4, dim=32)),  # GQA unexpanded a2a
+])
+def test_pp_sp_ulysses(arch, kw):
+    """Ulysses all-to-all as the pipeline's sequence-parallel transport
+    (cond units stay: all_to_all is a grouped collective)."""
+    base = dict(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                ffn_dim=64, max_seq_len=32, arch=arch)
+    base.update(kw)
+    cfg = dtpp.ModelConfig(**base)
+    prob = _problem(cfg)
+    mesh = make_mesh(n_pipe=2, n_seq=4)
+    step = make_pipeline_step(
+        cfg, mesh, dtpp.ScheduleConfig(name="1F1B", n_microbatches=2),
+        sp_attn_impl="ulysses")
+    _check(step, *prob)
+
+
+def test_bad_sp_attn_impl_rejected():
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64)
+    mesh = make_mesh(n_pipe=2, n_seq=2)
+    with pytest.raises(ValueError, match="sp_attn_impl"):
+        make_pipeline_step(cfg, mesh,
+                           dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
+                           sp_attn_impl="flash")
