@@ -27,13 +27,17 @@ Pytree = Any
 
 
 def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
-                    optimizer: optax.GradientTransformation,
+                    optimizer: optax.GradientTransformation, moe=None,
+                    sp_attn_impl: str = "ring",
                     ) -> Callable[[Pytree, Any, jax.Array, jax.Array],
                                   Tuple[Pytree, Any, jax.Array]]:
     """Jitted ``(params, opt_state, tokens, targets) ->
     (params, opt_state, loss)``: pipeline grads + optax update in one XLA
-    program (so the update fuses with the grad psum epilogue)."""
-    grad_fn = make_pipeline_grad_fn(cfg, mesh, sched)
+    program (so the update fuses with the grad psum epilogue). ``moe``
+    (a MoEConfig) selects MoE pipeline stages — see
+    :func:`..parallel.pipeline.make_pipeline_grad_fn`."""
+    grad_fn = make_pipeline_grad_fn(cfg, mesh, sched, moe=moe,
+                                    sp_attn_impl=sp_attn_impl)
 
     @jax.jit
     def train_step(params, opt_state, tokens, targets):
@@ -80,7 +84,8 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         log_every: int = 10, verbose: bool = True,
         checkpoint_dir: Optional[str] = None, checkpoint_every: int = 0,
         resume: bool = False, skip_data_on_resume: bool = True,
-        metrics_path: Optional[str] = None):
+        metrics_path: Optional[str] = None, moe=None,
+        sp_attn_impl: str = "ring"):
     """Training loop over a ``(tokens, targets)`` iterator.
 
     Returns (params, list of (step, loss)). The data contract matches the
@@ -103,7 +108,8 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
       batch*seq*steps / wall-clock between log points).
     """
     optimizer = optimizer or adamw(total_steps=num_steps)
-    step_fn = make_train_step(cfg, mesh, sched, optimizer)
+    step_fn = make_train_step(cfg, mesh, sched, optimizer, moe=moe,
+                              sp_attn_impl=sp_attn_impl)
     opt_state = optimizer.init(params)
 
     start_step = 0

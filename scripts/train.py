@@ -33,9 +33,11 @@ def main():
                     help="tensor-parallel (model-axis) size; composes with "
                          "--pipe/--data into a 3-D mesh")
     ap.add_argument("--sp", type=int, default=1,
-                    help="sequence-parallel (seq-axis) size: ring attention "
-                         "inside pipeline stages; composes with the other "
-                         "axes (4-D with --tp)")
+                    help="sequence-parallel (seq-axis) size: ring/Ulysses "
+                         "attention inside pipeline stages; composes with "
+                         "the other axes (4-D with --tp)")
+    ap.add_argument("--sp-attn", default="ring", choices=["ring", "ulysses"],
+                    help="sequence-parallel attention transport")
     ap.add_argument("--virtual", type=int, default=1)
     ap.add_argument("--microbatches", type=int, default=4)
     ap.add_argument("--steps", type=int, default=50)
@@ -77,9 +79,26 @@ def main():
                          "--auto-resume's data replay depends on")
     ap.add_argument("--prefetch", type=int, default=2,
                     help="device-prefetch depth (0 disables)")
+    ap.add_argument("--moe-experts", type=int, default=0,
+                    help="train a Mixture-of-Experts LM with this many "
+                         "experts (MoE blocks replace dense FFNs)")
+    ap.add_argument("--moe-topk", type=int, default=2)
+    ap.add_argument("--moe-capacity", type=float, default=1.25,
+                    help="capacity factor (slots per expert scale)")
+    ap.add_argument("--moe-aux", type=float, default=0.01,
+                    help="load-balancing aux loss weight")
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel (expert-axis) size; requires "
+                         "--moe-experts divisible by it")
     args = ap.parse_args()
     if args.native_loader and not args.data_file:
         ap.error("--native-loader requires --data-file")
+    if args.ep > 1 and not args.moe_experts:
+        ap.error("--ep requires --moe-experts")
+    if args.moe_experts and (args.tp > 1 or args.sp > 1):
+        ap.error("--moe-experts composes with --pipe/--data/--ep only")
+    if args.moe_experts and not args.model.startswith("gpt2-"):
+        ap.error("--moe-experts uses gpt2-style blocks; pick a gpt2-* model")
     if args.auto_resume and not args.ckpt:
         ap.error("--auto-resume requires --ckpt (the dir holding step_N/)")
 
@@ -122,21 +141,37 @@ def main():
         overrides["ffn_dim"] = max(1, round(base.ffn_dim * args.dim / base.dim))
     cfg = build_cfg(**overrides)
 
+    moe = None
+    if args.moe_experts:
+        from distributed_training_with_pipeline_parallelism_tpu.models.moe import (
+            MoEConfig)
+        moe = MoEConfig(n_experts=args.moe_experts, top_k=args.moe_topk,
+                        capacity_factor=args.moe_capacity,
+                        aux_loss_weight=args.moe_aux)
+
     mesh = make_mesh(n_pipe=args.pipe, n_data=args.data, n_model=args.tp,
-                     n_seq=args.sp)
+                     n_seq=args.sp, n_expert=args.ep)
     sched = dtpp.ScheduleConfig(name=args.schedule,
                                 n_microbatches=args.microbatches,
                                 n_virtual=args.virtual)
-    print(f"model={args.model} {cfg.dim}d x {cfg.n_layers}L x {cfg.n_heads}H, "
-          f"mesh=(data={args.data}, pipe={args.pipe}, model={args.tp}, "
-          f"seq={args.sp}), "
+    moe_desc = f" MoE E={args.moe_experts}" if moe else ""
+    print(f"model={args.model}{moe_desc} {cfg.dim}d x {cfg.n_layers}L x "
+          f"{cfg.n_heads}H, mesh=(data={args.data}, pipe={args.pipe}, "
+          f"model={args.tp}, seq={args.sp}, expert={args.ep}), "
           f"{args.schedule} M={args.microbatches} V={args.virtual}", flush=True)
 
     optimizer = train.adamw(learning_rate=args.lr, total_steps=args.steps)
+
+    def init_params(key):
+        if moe is not None:
+            from distributed_training_with_pipeline_parallelism_tpu.models.moe import (
+                moe_lm_init)
+            return moe_lm_init(key, cfg, moe)
+        return tfm.transformer_init(key, cfg)
+
     if args.resume:
         import jax.numpy as jnp
-        params_t = jax.eval_shape(lambda: tfm.transformer_init(
-            jax.random.key(args.seed), cfg))
+        params_t = jax.eval_shape(lambda: init_params(jax.random.key(args.seed)))
         # Accept either layout: a fit()-style dir of step_N/ trees
         # ({'params','opt_state','step'}), a single step_N dir, or a bare
         # params checkpoint (e.g. converted HF weights).
@@ -155,7 +190,7 @@ def main():
             params = restore_checkpoint(path, template=params_t)
         print(f"loaded params from {path}", flush=True)
     else:
-        params = tfm.transformer_init(jax.random.key(args.seed), cfg)
+        params = init_params(jax.random.key(args.seed))
 
     from distributed_training_with_pipeline_parallelism_tpu.utils.data import (
         TokenFileDataset, batch_sharding, prefetch_to_device)
@@ -178,7 +213,8 @@ def main():
         log_every=max(1, args.steps // 20),
         checkpoint_dir=args.ckpt or None,
         checkpoint_every=(args.ckpt_every or args.steps) if args.ckpt else 0,
-        resume=args.auto_resume, metrics_path=args.metrics or None)
+        resume=args.auto_resume, metrics_path=args.metrics or None, moe=moe,
+        sp_attn_impl=args.sp_attn)
     if args.ckpt:
         print(f"checkpoints in {args.ckpt}", flush=True)
     if history:
