@@ -99,6 +99,9 @@ def main():
         ap.error("--moe-experts composes with --pipe/--data/--ep only")
     if args.moe_experts and not args.model.startswith("gpt2-"):
         ap.error("--moe-experts uses gpt2-style blocks; pick a gpt2-* model")
+    if args.sp_attn == "ulysses" and args.tp > 1:
+        ap.error("--sp-attn ulysses does not compose with --tp "
+                 "(TP composes with ring attention only)")
     if args.auto_resume and not args.ckpt:
         ap.error("--auto-resume requires --ckpt (the dir holding step_N/)")
 
@@ -144,7 +147,7 @@ def main():
     moe = None
     if args.moe_experts:
         from distributed_training_with_pipeline_parallelism_tpu.models.moe import (
-            MoEConfig)
+            MoEConfig, moe_lm_init)
         moe = MoEConfig(n_experts=args.moe_experts, top_k=args.moe_topk,
                         capacity_factor=args.moe_capacity,
                         aux_loss_weight=args.moe_aux)
@@ -164,8 +167,6 @@ def main():
 
     def init_params(key):
         if moe is not None:
-            from distributed_training_with_pipeline_parallelism_tpu.models.moe import (
-                moe_lm_init)
             return moe_lm_init(key, cfg, moe)
         return tfm.transformer_init(key, cfg)
 
