@@ -113,7 +113,7 @@ def _forward_with_cache(cfg: ModelConfig, params: Pytree, cache: Pytree,
     rope_slice = None
     if cfg.arch == "llama":
         angles = rope_frequencies(cfg.head_dim, cache["k"].shape[2],
-                                  cfg.rope_theta)
+                                  cfg.rope_theta, cfg.rope_scaling)
         rope_slice = jax.lax.dynamic_slice_in_dim(angles, offset, s)
 
     def body(carry, xs):

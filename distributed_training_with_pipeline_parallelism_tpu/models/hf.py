@@ -108,13 +108,20 @@ def llama_config_from_hf(hf_config) -> ModelConfig:
     # Refuse configs whose semantics this conversion does not carry — a
     # silent pass-through here would produce plausible-looking wrong logits.
     scaling = getattr(hf_config, "rope_scaling", None)
-    # An explicit {'rope_type': 'default'} dict is transformers' spelling of
-    # plain RoPE (equivalent to rope_scaling=None) — allow it through.
-    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
-        raise NotImplementedError(
-            f"rope_scaling={scaling!r} (Llama-3.1+ long-context NTK/llama3 "
-            f"frequency scaling) is not supported by this converter; only "
-            f"plain RoPE with rope_theta is")
+    rope_scaling = None
+    if scaling:
+        rope_type = scaling.get("rope_type", scaling.get("type"))
+        if rope_type == "llama3":
+            rope_scaling = (float(scaling["factor"]),
+                            float(scaling["low_freq_factor"]),
+                            float(scaling["high_freq_factor"]),
+                            int(scaling["original_max_position_embeddings"]))
+        elif rope_type != "default":
+            # 'default' is transformers' spelling of plain RoPE; anything
+            # else (yarn, dynamic NTK, ...) is not carried by this converter
+            raise NotImplementedError(
+                f"rope_scaling={scaling!r} is not supported by this "
+                f"converter; plain RoPE and rope_type='llama3' are")
     if getattr(hf_config, "attention_bias", False):
         raise NotImplementedError(
             "attention_bias=True checkpoints are not supported (projection "
@@ -130,6 +137,7 @@ def llama_config_from_hf(hf_config) -> ModelConfig:
         vocab_size=hf_config.vocab_size, ffn_dim=hf_config.intermediate_size,
         max_seq_len=hf_config.max_position_embeddings, arch="llama",
         rope_theta=float(hf_config.rope_theta),
+        rope_scaling=rope_scaling,
         rms_eps=float(hf_config.rms_norm_eps))
 
 

@@ -17,12 +17,18 @@ def llama_config(name: str = "llama2-7b", **overrides) -> ModelConfig:
                            ffn_dim=13824, vocab_size=32000, rope_theta=1e4),
         "llama3-8b": dict(dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
                           ffn_dim=14336, vocab_size=128256, rope_theta=5e5),
+        # 3.1: same shape, 128k context via llama3 rope frequency scaling
+        "llama3.1-8b": dict(dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+                            ffn_dim=14336, vocab_size=128256, rope_theta=5e5,
+                            max_seq_len=131072,
+                            rope_scaling=(8.0, 1.0, 4.0, 8192)),
         # scaled-down variant with the same shape ratios for tests/benches
         "llama-debug": dict(dim=256, n_layers=8, n_heads=8, n_kv_heads=4,
                             ffn_dim=688, vocab_size=1024, rope_theta=1e4),
     }
     if name not in sizes:
         raise ValueError(f"unknown Llama size {name!r}; options: {sorted(sizes)}")
-    kw = dict(max_seq_len=4096, arch="llama", rms_eps=1e-5, **sizes[name])
+    kw = dict(max_seq_len=4096, arch="llama", rms_eps=1e-5)
+    kw.update(sizes[name])
     kw.update(overrides)
     return ModelConfig(**kw)

@@ -171,7 +171,8 @@ def embed_apply(cfg: ModelConfig, embed: Dict, tokens: jax.Array) -> jax.Array:
 def _rope(cfg: ModelConfig, seq_len: int) -> Optional[jax.Array]:
     if cfg.arch != "llama":
         return None
-    return rope_frequencies(cfg.head_dim, seq_len, cfg.rope_theta)
+    return rope_frequencies(cfg.head_dim, seq_len, cfg.rope_theta,
+                            cfg.rope_scaling)
 
 
 def body_apply(cfg: ModelConfig, layers: Dict, h: jax.Array,

@@ -38,9 +38,25 @@ def _split_heads(x: jax.Array, n_heads: int) -> jax.Array:
     return x.reshape(b, s, n_heads, -1)
 
 
-def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 10000.0) -> jax.Array:
-    """Precompute RoPE angles [max_seq_len, head_dim//2]."""
+def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 10000.0,
+                     scaling: Optional[tuple] = None) -> jax.Array:
+    """Precompute RoPE angles [max_seq_len, head_dim//2].
+
+    ``scaling`` applies Llama-3.1 long-context frequency scaling — a tuple
+    ``(factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings)`` matching transformers'
+    ``rope_scaling`` with ``rope_type="llama3"``: wavelengths shorter than
+    ``orig/high`` keep their frequency, longer than ``orig/low`` divide by
+    ``factor``, and the band between interpolates smoothly.
+    """
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling is not None:
+        factor, low_f, high_f, orig_max = scaling
+        wavelen = 2.0 * jnp.pi / inv
+        smooth = (orig_max / wavelen - low_f) / (high_f - low_f)
+        mid = (1.0 - smooth) * inv / factor + smooth * inv
+        inv = jnp.where(wavelen > orig_max / low_f, inv / factor,
+                        jnp.where(wavelen < orig_max / high_f, inv, mid))
     t = jnp.arange(max_seq_len, dtype=jnp.float32)
     return jnp.outer(t, inv)
 

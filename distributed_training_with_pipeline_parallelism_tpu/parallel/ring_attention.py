@@ -110,5 +110,6 @@ def local_rope_angles(cfg, seq_local: int, axis_name: str) -> jax.Array:
     """RoPE angles for this device's global position range."""
     my = jax.lax.axis_index(axis_name)
     D = jax.lax.psum(1, axis_name)
-    full = rope_frequencies(cfg.head_dim, seq_local * D, cfg.rope_theta)
+    full = rope_frequencies(cfg.head_dim, seq_local * D, cfg.rope_theta,
+                            cfg.rope_scaling)
     return jax.lax.dynamic_slice_in_dim(full, my * seq_local, seq_local, axis=0)

@@ -48,6 +48,9 @@ class ModelConfig:
     # Llama-only knobs.
     n_kv_heads: Optional[int] = None
     rope_theta: float = 10000.0
+    # Llama-3.1 rope scaling: (factor, low_freq_factor, high_freq_factor,
+    # original_max_position_embeddings), or None for plain RoPE.
+    rope_scaling: Optional[Tuple[float, float, float, int]] = None
     rms_eps: float = 1e-5
 
     def __post_init__(self):

@@ -77,3 +77,24 @@ def test_state_dict_input_and_dtype():
     assert np.allclose(np.asarray(p2["embed"]["tok"]),
                        np.asarray(params["embed"]["tok"], dtype=np.float32),
                        atol=1e-2)
+
+
+def test_llama3_rope_scaling_parity():
+    """Llama-3.1-style rope_scaling checkpoints convert and match torch
+    logits (the frequency-band scaling must replicate transformers')."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=211, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-6, rope_theta=10000.0,
+        attention_bias=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32})
+    with torch.no_grad():
+        model = transformers.LlamaForCausalLM(cfg).eval()
+    jcfg, params = from_hf(model)
+    assert jcfg.rope_scaling == (8.0, 1.0, 4.0, 32)
+    tokens = np.random.default_rng(0).integers(0, 211, (2, 48))
+    want = _torch_logits(model, tokens)
+    got = np.asarray(tfm.transformer_apply(jcfg, params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
