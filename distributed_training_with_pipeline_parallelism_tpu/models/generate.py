@@ -49,7 +49,8 @@ def init_cache(cfg: ModelConfig, batch_size: int, max_len: int,
 
 
 def _attend_cached(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-                   offset: jax.Array, n_heads: int) -> jax.Array:
+                   offset: jax.Array, n_heads: int,
+                   window: Optional[int] = None) -> jax.Array:
     """Attention of S new queries against the full cached sequence.
 
     q: [B, S, H, hd] at global positions offset..offset+S-1;
@@ -61,7 +62,10 @@ def _attend_cached(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     s, t = q.shape[1], k_cache.shape[1]
     q_pos = offset + jnp.arange(s)[:, None]
     k_pos = jnp.arange(t)[None, :]
-    out = scaled_dot_attention(q, k_cache, v_cache, (k_pos <= q_pos)[None, None])
+    mask = k_pos <= q_pos
+    if window is not None:
+        mask &= q_pos - k_pos < window
+    out = scaled_dot_attention(q, k_cache, v_cache, mask[None, None])
     return out.reshape(q.shape[0], s, -1)
 
 
@@ -89,7 +93,8 @@ def _layer_step(cfg: ModelConfig, lp: Pytree, h: jax.Array, k_cache: jax.Array,
     v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
                                            (0, offset, 0, 0))
     attn = linear_apply(ap["o"], _attend_cached(q, k_cache, v_cache, offset,
-                                                cfg.n_heads))
+                                                cfg.n_heads,
+                                                cfg.sliding_window))
     return mlp_block(cfg, lp, h + attn), k_cache, v_cache
 
 

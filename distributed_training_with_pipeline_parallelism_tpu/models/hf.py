@@ -130,6 +130,12 @@ def llama_config_from_hf(hf_config) -> ModelConfig:
         raise NotImplementedError(
             "mlp_bias=True checkpoints are not supported (gate/up/down "
             "projection biases would be dropped)")
+    hd = getattr(hf_config, "head_dim", None)
+    if hd and hd != hf_config.hidden_size // hf_config.num_attention_heads:
+        raise NotImplementedError(
+            f"decoupled head_dim={hd} != hidden_size/num_heads="
+            f"{hf_config.hidden_size // hf_config.num_attention_heads} "
+            f"(Mistral-Nemo-class checkpoints) is not supported")
     return ModelConfig(
         dim=hf_config.hidden_size, n_layers=hf_config.num_hidden_layers,
         n_heads=hf_config.num_attention_heads,
@@ -138,6 +144,7 @@ def llama_config_from_hf(hf_config) -> ModelConfig:
         max_seq_len=hf_config.max_position_embeddings, arch="llama",
         rope_theta=float(hf_config.rope_theta),
         rope_scaling=rope_scaling,
+        sliding_window=getattr(hf_config, "sliding_window", None),
         rms_eps=float(hf_config.rms_norm_eps))
 
 
@@ -187,6 +194,9 @@ def _to_dtype(params: Pytree, cfg: ModelConfig) -> Pytree:
 _CONVERTERS = {
     "gpt2": (gpt2_config_from_hf, gpt2_params_from_hf),
     "llama": (llama_config_from_hf, llama_params_from_hf),
+    # Mistral = llama blocks + sliding-window attention; identical state
+    # dict layout, window carried via config.sliding_window
+    "mistral": (llama_config_from_hf, llama_params_from_hf),
 }
 
 

@@ -22,6 +22,15 @@ def llama_config(name: str = "llama2-7b", **overrides) -> ModelConfig:
                             ffn_dim=14336, vocab_size=128256, rope_theta=5e5,
                             max_seq_len=131072,
                             rope_scaling=(8.0, 1.0, 4.0, 8192)),
+        # Mistral v0.1: llama blocks + 4096-token sliding-window attention
+        "mistral-7b-v0.1": dict(dim=4096, n_layers=32, n_heads=32,
+                                n_kv_heads=8, ffn_dim=14336, vocab_size=32000,
+                                rope_theta=1e4, max_seq_len=32768,
+                                sliding_window=4096),
+        # Mistral v0.3: full attention, 1e6 theta, extended vocab
+        "mistral-7b-v0.3": dict(dim=4096, n_layers=32, n_heads=32,
+                                n_kv_heads=8, ffn_dim=14336, vocab_size=32768,
+                                rope_theta=1e6, max_seq_len=32768),
         # scaled-down variant with the same shape ratios for tests/benches
         "llama-debug": dict(dim=256, n_layers=8, n_heads=8, n_kv_heads=4,
                             ffn_dim=688, vocab_size=1024, rope_theta=1e4),

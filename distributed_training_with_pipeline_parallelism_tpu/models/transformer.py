@@ -99,7 +99,8 @@ def layer_apply(cfg: ModelConfig, params: Dict, h: jax.Array,
     if cfg.arch == "llama":
         a = rms_norm_apply(params["rms1"], h, cfg.rms_eps)
         h = h + mha_apply(params["attn"], a, a, heads, causal=cfg.causal,
-                          rope_angles=rope_angles, flash=fl, tp_axis=tp_axis)
+                          rope_angles=rope_angles, flash=fl, tp_axis=tp_axis,
+                          window=cfg.sliding_window)
         return mlp_block(cfg, params, h, tp_axis=tp_axis)
     raise ValueError(f"unknown arch {cfg.arch!r}")
 

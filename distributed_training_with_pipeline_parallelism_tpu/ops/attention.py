@@ -120,7 +120,8 @@ def qkv_project(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
 
 def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
               causal: bool = False, rope_angles: Optional[jax.Array] = None,
-              flash: bool = False, tp_axis: Optional[str] = None) -> jax.Array:
+              flash: bool = False, tp_axis: Optional[str] = None,
+              window: Optional[int] = None) -> jax.Array:
     """Attention: queries from ``q_in``, keys/values from ``kv_in`` (both [b, s, d]).
 
     ``flash=True`` routes the core attention through the fused Pallas kernel
@@ -135,6 +136,10 @@ def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
     from .collectives import tp_attention_inputs, tp_output_projection
     q_in, kv_in = tp_attention_inputs(q_in, kv_in, tp_axis)
     q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles)
+    if flash and window is not None:
+        raise NotImplementedError(
+            "the flash kernel has no sliding-window band mask yet; "
+            "long-window models must run with use_flash_attention=False")
     if flash:
         from .pallas_attention import flash_attention
         out = flash_attention(q, k, v, causal=causal)
@@ -142,7 +147,12 @@ def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
         mask = None
         if causal:
             s = q_in.shape[1]
-            mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None]
+            iq = jnp.arange(s)[:, None]
+            ik = jnp.arange(s)[None, :]
+            mask = iq >= ik
+            if window is not None:
+                mask &= iq - ik < window
+            mask = mask[None, None]
         out = scaled_dot_attention(q, k, v, mask)
     out = out.reshape(q_in.shape[0], q_in.shape[1], -1)
     return tp_output_projection(params["o"], out, tp_axis)

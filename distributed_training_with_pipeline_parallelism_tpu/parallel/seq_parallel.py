@@ -49,6 +49,10 @@ def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
         raise NotImplementedError(
             "tensor parallelism composes with ring attention only (Ulysses "
             "already shards heads over the seq axis)")
+    if cfg.sliding_window is not None:
+        raise NotImplementedError(
+            "sliding-window attention is not sequence-parallel yet; use the "
+            "dense pipeline/TP paths for Mistral-family models")
     sp_mha = ATTN_IMPLS[attn_impl]
     heads = cfg.n_heads // tp_size
     if cfg.arch == "ref_decoder":

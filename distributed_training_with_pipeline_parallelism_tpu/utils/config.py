@@ -52,6 +52,9 @@ class ModelConfig:
     # original_max_position_embeddings), or None for plain RoPE.
     rope_scaling: Optional[Tuple[float, float, float, int]] = None
     rms_eps: float = 1e-5
+    # Mistral-style sliding-window attention: each position attends to at
+    # most this many preceding positions (None = full causal). llama arch.
+    sliding_window: Optional[int] = None
 
     def __post_init__(self):
         if self.dim % self.n_heads != 0:
@@ -60,6 +63,13 @@ class ModelConfig:
             raise ValueError(f"n_heads={self.n_heads} must be divisible by n_kv_heads={self.n_kv_heads}")
         if self.arch not in ("ref_decoder", "gpt2", "llama"):
             raise ValueError(f"unknown arch {self.arch!r}")
+        if self.sliding_window is not None:
+            if self.arch != "llama":
+                raise ValueError("sliding_window requires arch='llama' "
+                                 "(Mistral-family blocks)")
+            if self.sliding_window < 1:
+                raise ValueError(f"sliding_window={self.sliding_window} must "
+                                 f"be >= 1")
         if self.dropout != 0.0:
             raise ValueError("dropout is not implemented yet; the reference implicitly "
                              "trains with torch's default 0.1 but never asserts loss "
