@@ -203,7 +203,9 @@ _CONVERTERS = {
 def from_hf(model, dtype: str = "float32") -> Tuple[ModelConfig, Pytree]:
     """Convert a ``transformers`` causal-LM model to (ModelConfig, params).
 
-    Dispatches on the HF config's ``model_type`` ("gpt2" or "llama").
+    Dispatches on the HF config's ``model_type`` ("gpt2", "llama", or
+    "mistral" — Mistral shares the llama converter, carrying its
+    sliding window).
     """
     import dataclasses
 

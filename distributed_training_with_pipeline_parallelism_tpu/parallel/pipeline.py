@@ -552,3 +552,76 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     return jax.jit(make_pipeline_grad_fn(
         cfg, mesh, sched, force_tick_executor=force_tick_executor, moe=moe,
         sp_attn_impl=sp_attn_impl))
+
+
+def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
+                          ) -> Callable[[Pytree, jax.Array], jax.Array]:
+    """Jitted forward-only pipeline: ``(params, tokens) -> logits [B, S, V]``.
+
+    The parity twin of upstream's ``PipelineScheduleSingle.step`` return
+    value — per-microbatch last-stage outputs merged back into the
+    full-batch logits (``merge_chunks``, ``schedules.py:794-798``). Runs a
+    fill-drain forward (every schedule's forward order is fill-drain; no
+    backward), so it doubles as pipelined batch inference. Dense stages
+    only (no model/seq/expert axes).
+    """
+    D = mesh.shape[PIPE_AXIS]
+    for axis in (MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS):
+        if mesh.shape.get(axis, 1) > 1:
+            raise NotImplementedError(
+                f"make_pipeline_forward supports data x pipe meshes only "
+                f"(got a '{axis}' axis)")
+    M = sched.n_microbatches
+    if cfg.n_layers % D:
+        raise ValueError(f"n_layers={cfg.n_layers} must divide over {D} stages")
+    dtype = jnp.dtype(cfg.dtype)
+    fwd_perm = [(i, (i + 1) % D) for i in range(D)]
+
+    def spmd_fn(layers_stacked, embed, head, tokens):
+        d = jax.lax.axis_index(PIPE_AXIS)
+        layers_local = jax.tree.map(lambda x: x[0, 0], layers_stacked)
+        b_local, seq = tokens.shape
+        assert b_local % M == 0, (
+            f"local batch {b_local} not divisible by n_microbatches={M}")
+        mb = b_local // M
+        tokens_mb = tokens.reshape(M, mb, seq)
+
+        def tick(carry, t):
+            recv, out = carry
+            m = t - d  # fill-drain: device d runs microbatch t-d at tick t
+            active = (m >= 0) & (m < M)
+            mm = jnp.clip(m, 0, M - 1)
+            x_emb = embed_apply(cfg, embed, tokens_mb[mm]).astype(dtype)
+            x = jnp.where(d == 0, x_emb, recv)
+            y = jax.lax.cond(
+                active,
+                lambda: body_apply(cfg, layers_local, x),
+                lambda: jnp.zeros((mb, seq, cfg.dim), dtype))
+            is_last = d == D - 1
+            logits_mb = jax.lax.cond(
+                active & is_last,
+                lambda: head_apply(cfg, head, y).astype(jnp.float32),
+                lambda: jnp.zeros((mb, seq, cfg.vocab_size), jnp.float32))
+            out = out.at[mm].set(jnp.where(active & is_last, logits_mb,
+                                           out[mm]))
+            return (jax.lax.ppermute(y, PIPE_AXIS, fwd_perm), out), None
+
+        out0 = jnp.zeros((M, mb, seq, cfg.vocab_size), jnp.float32)
+        recv0 = jnp.zeros((mb, seq, cfg.dim), dtype)
+        (_, out), _ = jax.lax.scan(tick, (recv0, out0), jnp.arange(M + D - 1))
+        # logits live on the last pipe device; replicate via psum of zeros
+        out = jax.lax.psum(jnp.where(d == D - 1, out, 0.0), PIPE_AXIS)
+        return out.reshape(b_local, seq, cfg.vocab_size)
+
+    sharded = _shard_map(
+        spmd_fn, mesh,
+        in_specs=(P(PIPE_AXIS), P(), P(), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+    )
+
+    @jax.jit
+    def forward(params, tokens):
+        stacked = stack_stage_layers(params["layers"], D, 1)
+        return sharded(stacked, params["embed"], params["head"], tokens)
+
+    return forward

@@ -134,3 +134,34 @@ def test_gpt2_and_llama_through_pipeline():
             cfg, mesh, dtpp.ScheduleConfig(name="1F1B", n_microbatches=4))
         loss, grads = step(params, tokens, targets)
         assert_matches_reference(loss, grads, ref_loss, ref_grads, tol=2e-5)
+
+
+def test_pipeline_forward_returns_merged_logits(problem):
+    """U5 parity: the forward-only pipeline returns the merged full-batch
+    last-stage logits (upstream merge_chunks semantics), equal to the
+    single-device forward."""
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        make_pipeline_forward)
+
+    params, tokens, _, _, _ = problem
+    want = tfm.transformer_apply(CFG, params, tokens)
+    fwd = make_pipeline_forward(CFG, make_mesh(n_pipe=4),
+                                dtpp.ScheduleConfig(name="GPipe",
+                                                    n_microbatches=4))
+    got = fwd(params, tokens)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_forward_with_data_axis(problem):
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        make_pipeline_forward)
+
+    params, tokens, _, _, _ = problem
+    want = tfm.transformer_apply(CFG, params, tokens)
+    fwd = make_pipeline_forward(CFG, make_mesh(n_pipe=2, n_data=2),
+                                dtpp.ScheduleConfig(name="1F1B",
+                                                    n_microbatches=2))
+    np.testing.assert_allclose(np.asarray(fwd(params, tokens)),
+                               np.asarray(want), atol=1e-5, rtol=1e-5)
