@@ -193,12 +193,16 @@ def body_apply(cfg: ModelConfig, layers: Dict, h: jax.Array,
     return out
 
 
-def head_apply(cfg: ModelConfig, head: Dict, h: jax.Array) -> jax.Array:
+def head_norm_apply(cfg: ModelConfig, head: Dict, h: jax.Array) -> jax.Array:
+    """The head's final norm (arch-dispatched) — shared with the executor's
+    vocab-parallel loss branch so the two cannot drift."""
     if cfg.arch == "llama":
-        h = rms_norm_apply(head["norm"], h, cfg.rms_eps)
-    else:
-        h = layer_norm_apply(head["norm"], h)
-    return linear_apply(head["out"], h)
+        return rms_norm_apply(head["norm"], h, cfg.rms_eps)
+    return layer_norm_apply(head["norm"], h)
+
+
+def head_apply(cfg: ModelConfig, head: Dict, h: jax.Array) -> jax.Array:
+    return linear_apply(head["out"], head_norm_apply(cfg, head, h))
 
 
 def transformer_apply(cfg: ModelConfig, params: Dict, tokens: jax.Array) -> jax.Array:

@@ -83,3 +83,39 @@ def tp_output_projection(o_params, out, tp_axis):
         from .layers import linear_apply
         return linear_apply(o_params, out)
     return row_parallel_linear(o_params, out, tp_axis)
+
+
+def vocab_parallel_xent(logits_local: jax.Array, targets: jax.Array,
+                        axis_name: str) -> jax.Array:
+    """Mean token-wise cross entropy over a vocab-sharded logits tensor
+    (Megatron parallel cross-entropy, arXiv:1909.08053 §3): each device
+    holds a contiguous vocab slice ``[my*Vl, (my+1)*Vl)`` of the logits
+    ``[..., V_local]``; the full ``[..., V]`` tensor never materializes.
+
+    The max for numerical stability is a stop-gradient pmax; logsumexp and
+    the target logit each take one psum over ``axis_name``. Differentiable
+    w.r.t. ``logits_local`` (grouped collectives: safe inside schedule
+    conds).
+    """
+    import jax.numpy as jnp
+
+    v_local = logits_local.shape[-1]
+    my = jax.lax.axis_index(axis_name)
+    x = logits_local.astype(jnp.float32)
+    # stop_gradient BEFORE the collective: pmax has no differentiation rule,
+    # but with a symbolic-zero tangent it never needs one (the max is only
+    # a numerical-stability shift anyway)
+    m = jax.lax.pmax(
+        jax.lax.stop_gradient(jnp.max(x, axis=-1)), axis_name)  # [...]
+    # tp_reduce, not raw psum: under check_vma=False a psum inside a
+    # differentiated region transposes to another psum (double-counting
+    # cotangents); tp_reduce's custom VJP encodes the correct
+    # psum-fwd/identity-bwd pair.
+    lse = jnp.log(tp_reduce(
+        jnp.sum(jnp.exp(x - m[..., None]), axis=-1), axis_name)) + m
+    local_t = targets - my * v_local
+    hit = (local_t >= 0) & (local_t < v_local)
+    tl_part = jnp.take_along_axis(
+        x, jnp.clip(local_t, 0, v_local - 1)[..., None], axis=-1)[..., 0]
+    tl = tp_reduce(jnp.where(hit, tl_part, 0.0), axis_name)
+    return jnp.mean(lse - tl)

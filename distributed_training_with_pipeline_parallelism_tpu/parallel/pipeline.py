@@ -45,8 +45,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.transformer import (body_apply, embed_apply, head_apply,
-                                  transformer_loss)
-from ..ops.layers import select_xent
+                                  head_norm_apply, transformer_loss)
+from ..ops.layers import linear_apply, select_xent
 from ..utils.config import ModelConfig, ScheduleConfig
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS,
                    SEQ_AXIS)
@@ -122,6 +122,7 @@ def unstack_stage_layers(stacked: Pytree) -> Pytree:
 def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                           force_tick_executor: bool = False, moe=None,
                           sp_attn_impl: str = "ring",
+                          tp_vocab_parallel: bool = False,
                           ) -> Callable[[Pytree, jax.Array, jax.Array],
                                         Tuple[jax.Array, Pytree]]:
     """Build an (unjitted) ``(params, tokens, targets) -> (loss, grads)``
@@ -150,6 +151,12 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     if sp_attn_impl not in ("ring", "ulysses"):
         raise ValueError(f"sp_attn_impl must be 'ring' or 'ulysses', "
                          f"got {sp_attn_impl!r}")
+    if tp_vocab_parallel:
+        if T <= 1:
+            raise ValueError("tp_vocab_parallel needs a 'model' mesh axis")
+        if cfg.vocab_size % T:
+            raise ValueError(f"vocab_size={cfg.vocab_size} must divide over "
+                             f"the model-axis size {T}")
     # Only ring attention puts a ppermute (flat-pair collective) inside the
     # schedule units; Ulysses' all_to_all is grouped, so its units may keep
     # the efficient cond dispatch.
@@ -272,8 +279,18 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             y, aux = stage_body(p_v, x_in)
 
             def loss_branch():
-                local = select_xent(cfg.use_fused_xent)(
-                    head_apply(cfg, head_p, y), targets_mb[mm])
+                if tp_vocab_parallel:
+                    # Megatron parallel CE: head matmul column-split over
+                    # 'model'; the [mb, s, V] logits never materialize.
+                    from ..ops.collectives import tp_copy, vocab_parallel_xent
+                    yn = head_norm_apply(cfg, head_p, y)
+                    logits_local = linear_apply(head_p["out"],
+                                                tp_copy(yn, tp_axis))
+                    local = vocab_parallel_xent(logits_local, targets_mb[mm],
+                                                tp_axis)
+                else:
+                    local = select_xent(cfg.use_fused_xent)(
+                        head_apply(cfg, head_p, y), targets_mb[mm])
                 return local / loss_norm
 
             main = jax.lax.cond(
@@ -516,10 +533,19 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         batch_spec = P((DATA_AXIS, EXPERT_AXIS))  # batch over data x expert
     else:
         batch_spec = P(DATA_AXIS)
+    if tp_vocab_parallel:
+        # vocab-sharded head: out.w [dim, V] column-split, bias (ref arch)
+        # split with it; the norm stays replicated
+        out_spec = ({"w": P(None, MODEL_AXIS), "b": P(MODEL_AXIS)}
+                    if cfg.arch == "ref_decoder"
+                    else {"w": P(None, MODEL_AXIS)})
+        head_spec = {"norm": P(), "out": out_spec}
+    else:
+        head_spec = P()
     sharded = _shard_map(
         spmd_fn, mesh,
-        in_specs=(layer_spec, P(), P(), batch_spec, batch_spec),
-        out_specs=(P(), layer_spec, P(), P()),
+        in_specs=(layer_spec, P(), head_spec, batch_spec, batch_spec),
+        out_specs=(P(), layer_spec, P(), head_spec),
     )
 
     def step(params, tokens, targets):
@@ -539,6 +565,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                        force_tick_executor: bool = False, moe=None,
                        sp_attn_impl: str = "ring",
+                       tp_vocab_parallel: bool = False,
                        ) -> Callable[[Pytree, jax.Array, jax.Array],
                                      Tuple[jax.Array, Pytree]]:
     """Jitted ``(params, tokens, targets) -> (loss, grads)`` pipeline step.
@@ -551,7 +578,7 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     """
     return jax.jit(make_pipeline_grad_fn(
         cfg, mesh, sched, force_tick_executor=force_tick_executor, moe=moe,
-        sp_attn_impl=sp_attn_impl))
+        sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel))
 
 
 def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
@@ -572,6 +599,11 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 f"make_pipeline_forward supports data x pipe meshes only "
                 f"(got a '{axis}' axis)")
     M = sched.n_microbatches
+    if sched.n_virtual != 1:
+        raise NotImplementedError(
+            "make_pipeline_forward runs 1 stage/device (fill-drain forward); "
+            "virtual stages are a training-schedule concept")
+    _compile(sched.name, D, 1, M)  # same validation contract as the grad path
     if cfg.n_layers % D:
         raise ValueError(f"n_layers={cfg.n_layers} must divide over {D} stages")
     dtype = jnp.dtype(cfg.dtype)
@@ -591,11 +623,16 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             m = t - d  # fill-drain: device d runs microbatch t-d at tick t
             active = (m >= 0) & (m < M)
             mm = jnp.clip(m, 0, M - 1)
-            x_emb = embed_apply(cfg, embed, tokens_mb[mm]).astype(dtype)
-            x = jnp.where(d == 0, x_emb, recv)
+
+            def active_fn():
+                x = jax.lax.cond(
+                    d == 0,
+                    lambda: embed_apply(cfg, embed, tokens_mb[mm]).astype(dtype),
+                    lambda: recv)
+                return body_apply(cfg, layers_local, x)
+
             y = jax.lax.cond(
-                active,
-                lambda: body_apply(cfg, layers_local, x),
+                active, active_fn,
                 lambda: jnp.zeros((mb, seq, cfg.dim), dtype))
             is_last = d == D - 1
             logits_mb = jax.lax.cond(

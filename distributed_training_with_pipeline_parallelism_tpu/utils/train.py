@@ -29,6 +29,7 @@ Pytree = Any
 def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     optimizer: optax.GradientTransformation, moe=None,
                     sp_attn_impl: str = "ring",
+                    tp_vocab_parallel: bool = False,
                     ) -> Callable[[Pytree, Any, jax.Array, jax.Array],
                                   Tuple[Pytree, Any, jax.Array]]:
     """Jitted ``(params, opt_state, tokens, targets) ->
@@ -37,7 +38,8 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     (a MoEConfig) selects MoE pipeline stages — see
     :func:`..parallel.pipeline.make_pipeline_grad_fn`."""
     grad_fn = make_pipeline_grad_fn(cfg, mesh, sched, moe=moe,
-                                    sp_attn_impl=sp_attn_impl)
+                                    sp_attn_impl=sp_attn_impl,
+                                    tp_vocab_parallel=tp_vocab_parallel)
 
     @jax.jit
     def train_step(params, opt_state, tokens, targets):
@@ -85,7 +87,7 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         checkpoint_dir: Optional[str] = None, checkpoint_every: int = 0,
         resume: bool = False, skip_data_on_resume: bool = True,
         metrics_path: Optional[str] = None, moe=None,
-        sp_attn_impl: str = "ring"):
+        sp_attn_impl: str = "ring", tp_vocab_parallel: bool = False):
     """Training loop over a ``(tokens, targets)`` iterator.
 
     Returns (params, list of (step, loss)). The data contract matches the
@@ -109,7 +111,8 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
     """
     optimizer = optimizer or adamw(total_steps=num_steps)
     step_fn = make_train_step(cfg, mesh, sched, optimizer, moe=moe,
-                              sp_attn_impl=sp_attn_impl)
+                              sp_attn_impl=sp_attn_impl,
+                              tp_vocab_parallel=tp_vocab_parallel)
     opt_state = optimizer.init(params)
 
     start_step = 0

@@ -38,6 +38,10 @@ def main():
                          "the other axes (4-D with --tp)")
     ap.add_argument("--sp-attn", default="ring", choices=["ring", "ulysses"],
                     help="sequence-parallel attention transport")
+    ap.add_argument("--vocab-parallel", action="store_true",
+                    help="Megatron parallel cross-entropy: vocab-shard the "
+                         "head over the --tp model axis (logits never "
+                         "materialize full-size)")
     ap.add_argument("--virtual", type=int, default=1)
     ap.add_argument("--microbatches", type=int, default=4)
     ap.add_argument("--steps", type=int, default=50)
@@ -102,6 +106,8 @@ def main():
     if args.sp_attn == "ulysses" and args.sp > 1 and args.tp > 1:
         ap.error("--sp-attn ulysses does not compose with --tp "
                  "(TP composes with ring attention only)")
+    if args.vocab_parallel and args.tp <= 1:
+        ap.error("--vocab-parallel requires --tp > 1")
     if args.auto_resume and not args.ckpt:
         ap.error("--auto-resume requires --ckpt (the dir holding step_N/)")
 
@@ -215,7 +221,7 @@ def main():
         checkpoint_dir=args.ckpt or None,
         checkpoint_every=(args.ckpt_every or args.steps) if args.ckpt else 0,
         resume=args.auto_resume, metrics_path=args.metrics or None, moe=moe,
-        sp_attn_impl=args.sp_attn)
+        sp_attn_impl=args.sp_attn, tp_vocab_parallel=args.vocab_parallel)
     if args.ckpt:
         print(f"checkpoints in {args.ckpt}", flush=True)
     if history:

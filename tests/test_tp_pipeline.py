@@ -98,3 +98,46 @@ def test_grads_are_genuinely_sharded_over_model():
     _, grads = step(params, tokens, targets)
     same = jax.tree.map(lambda g, p: g.shape == p.shape, grads, params)
     assert all(jax.tree.leaves(same))
+
+
+@pytest.mark.parametrize("arch,kw", [
+    ("ref_decoder", {}),           # head has a bias -> bias vocab-split too
+    ("gpt2", {}),
+    ("llama", dict(n_kv_heads=2)),
+])
+def test_vocab_parallel_head(arch, kw):
+    """Megatron parallel cross-entropy: head column-split over 'model', the
+    full logits never materialize, loss/grads still match single-device."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=16, arch=arch, **kw)
+    prob = _problem(cfg)
+    mesh = make_mesh(n_pipe=2, n_model=2)
+    step = make_pipeline_step(
+        cfg, mesh, dtpp.ScheduleConfig(name="1F1B", n_microbatches=4),
+        tp_vocab_parallel=True)
+    _check(step, *prob)
+
+
+def test_vocab_parallel_head_with_dp():
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, arch="gpt2")
+    prob = _problem(cfg)
+    mesh = make_mesh(n_pipe=2, n_data=2, n_model=2)
+    step = make_pipeline_step(
+        cfg, mesh, dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
+        tp_vocab_parallel=True)
+    _check(step, *prob)
+
+
+def test_vocab_parallel_head_validation():
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=63,
+                           ffn_dim=64, arch="gpt2")
+    mesh = make_mesh(n_pipe=2, n_model=2)
+    with pytest.raises(ValueError, match="divide over"):
+        make_pipeline_step(cfg, mesh,
+                           dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
+                           tp_vocab_parallel=True)
+    with pytest.raises(ValueError, match="model.*axis"):
+        make_pipeline_step(cfg, make_mesh(n_pipe=2),
+                           dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
+                           tp_vocab_parallel=True)
