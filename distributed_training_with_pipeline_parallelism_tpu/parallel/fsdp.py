@@ -55,10 +55,18 @@ def fsdp_specs(params: Pytree, n_shards: int) -> Pytree:
 
 
 def shard_params_fsdp(params: Pytree, mesh: Mesh) -> Pytree:
+    """Place a pytree with :func:`fsdp_specs` shardings. Non-array leaves
+    (e.g. optax step counters' python ints) pass through untouched, so this
+    also serves ZeRO-1 optimizer-state placement (``utils.train``)."""
     n = mesh.shape[DATA_AXIS]
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, fsdp_specs(params, n), is_leaf=lambda x: isinstance(x, P))
+
+    def place(x, spec):
+        if not hasattr(x, "ndim"):
+            return x
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, params, fsdp_specs(params, n),
+                        is_leaf=lambda x: isinstance(x, P))
 
 
 def make_fsdp_grad_fn(cfg: ModelConfig, mesh: Mesh, params_template: Pytree,

@@ -603,7 +603,11 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         raise NotImplementedError(
             "make_pipeline_forward runs 1 stage/device (fill-drain forward); "
             "virtual stages are a training-schedule concept")
-    _compile(sched.name, D, 1, M)  # same validation contract as the grad path
+    if M < 1:
+        raise ValueError(f"n_microbatches={M} must be >= 1")
+    # No schedule compilation: every schedule's *forward* order is the same
+    # fill-drain, so training-only constraints (e.g. 1F1B's M >= D) do not
+    # apply to batch inference. ScheduleConfig already validates the name.
     if cfg.n_layers % D:
         raise ValueError(f"n_layers={cfg.n_layers} must divide over {D} stages")
     dtype = jnp.dtype(cfg.dtype)

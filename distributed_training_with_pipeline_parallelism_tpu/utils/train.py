@@ -51,6 +51,25 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     return train_step
 
 
+def shard_opt_state(opt_state: Pytree, mesh: Mesh) -> Pytree:
+    """ZeRO-1: place optimizer-state leaves (Adam moments etc.) sharded over
+    the mesh's 'data' axis, each on its largest divisible dimension
+    (reusing the FSDP placement rule). Parameters stay replicated; the
+    train step's elementwise update computes on local shards and XLA
+    all-gathers the (sharded) updates back onto the replicated params —
+    the ZeRO-1 dataflow from sharding annotations alone. Committed input
+    shardings propagate through jit — the returned state keeps its data
+    sharding across steps (asserted in tests/test_fsdp.py). Composes with
+    every pipeline configuration (the grad function runs under its own
+    shard_map; only the optax update is affected)."""
+    from ..parallel.fsdp import shard_params_fsdp
+    from ..parallel.mesh import DATA_AXIS
+
+    if mesh.shape.get(DATA_AXIS, 1) <= 1:
+        return opt_state
+    return shard_params_fsdp(opt_state, mesh)
+
+
 def adamw(learning_rate: float = 3e-4, weight_decay: float = 0.01,
           warmup_steps: int = 100, total_steps: int = 10000,
           max_grad_norm: float = 1.0) -> optax.GradientTransformation:
@@ -87,7 +106,8 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         checkpoint_dir: Optional[str] = None, checkpoint_every: int = 0,
         resume: bool = False, skip_data_on_resume: bool = True,
         metrics_path: Optional[str] = None, moe=None,
-        sp_attn_impl: str = "ring", tp_vocab_parallel: bool = False):
+        sp_attn_impl: str = "ring", tp_vocab_parallel: bool = False,
+        zero1: bool = False):
     """Training loop over a ``(tokens, targets)`` iterator.
 
     Returns (params, list of (step, loss)). The data contract matches the
@@ -114,6 +134,8 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                               sp_attn_impl=sp_attn_impl,
                               tp_vocab_parallel=tp_vocab_parallel)
     opt_state = optimizer.init(params)
+    if zero1:
+        opt_state = shard_opt_state(opt_state, mesh)
 
     start_step = 0
     if resume and checkpoint_dir:
@@ -124,6 +146,10 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                 "params": params, "opt_state": opt_state,
                 "step": jnp.asarray(0)})
             params, opt_state = state["params"], state["opt_state"]
+            if zero1:
+                # the restore template carries no shardings; re-apply so a
+                # resumed run keeps the ZeRO-1 memory footprint
+                opt_state = shard_opt_state(opt_state, mesh)
             start_step = int(state["step"]) + 1
             if skip_data_on_resume:
                 for _ in range(start_step):

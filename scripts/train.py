@@ -38,6 +38,8 @@ def main():
                          "the other axes (4-D with --tp)")
     ap.add_argument("--sp-attn", default="ring", choices=["ring", "ulysses"],
                     help="sequence-parallel attention transport")
+    ap.add_argument("--zero1", action="store_true",
+                    help="ZeRO-1: shard optimizer state over the data axis")
     ap.add_argument("--vocab-parallel", action="store_true",
                     help="Megatron parallel cross-entropy: vocab-shard the "
                          "head over the --tp model axis (logits never "
@@ -221,7 +223,8 @@ def main():
         checkpoint_dir=args.ckpt or None,
         checkpoint_every=(args.ckpt_every or args.steps) if args.ckpt else 0,
         resume=args.auto_resume, metrics_path=args.metrics or None, moe=moe,
-        sp_attn_impl=args.sp_attn, tp_vocab_parallel=args.vocab_parallel)
+        sp_attn_impl=args.sp_attn, tp_vocab_parallel=args.vocab_parallel,
+        zero1=args.zero1)
     if args.ckpt:
         print(f"checkpoints in {args.ckpt}", flush=True)
     if history:

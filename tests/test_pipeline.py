@@ -165,3 +165,18 @@ def test_pipeline_forward_with_data_axis(problem):
                                                     n_microbatches=2))
     np.testing.assert_allclose(np.asarray(fwd(params, tokens)),
                                np.asarray(want), atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_forward_ignores_training_only_constraints(problem):
+    """Batch inference with fewer microbatches than stages is legal: the
+    forward order is fill-drain for every schedule."""
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        make_pipeline_forward)
+
+    params, tokens, _, _, _ = problem
+    want = tfm.transformer_apply(CFG, params, tokens)
+    fwd = make_pipeline_forward(CFG, make_mesh(n_pipe=4),
+                                dtpp.ScheduleConfig(name="1F1B",
+                                                    n_microbatches=2))
+    np.testing.assert_allclose(np.asarray(fwd(params, tokens)),
+                               np.asarray(want), atol=1e-5, rtol=1e-5)
