@@ -39,8 +39,8 @@ def fsdp_specs(params: Pytree, n_shards: int) -> Pytree:
     weight matrices over the layer-stack axis)."""
 
     def spec_for(x) -> P:
-        if x.ndim == 0:
-            return P()
+        if not hasattr(x, "ndim") or x.ndim == 0:
+            return P()  # python scalars (optax counters) and 0-d arrays
         sizes = list(x.shape)
         order = sorted(range(x.ndim), key=lambda i: (sizes[i], i != 0),
                        reverse=True)

@@ -34,7 +34,16 @@ def restore_checkpoint(path: str, template: Optional[Pytree] = None) -> Pytree:
     import jax
     ckpt = _checkpointer()
     if template is not None:
-        target = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template)
-        return ckpt.restore(os.path.abspath(path), target)
+        from jax.sharding import NamedSharding
+
+        def as_struct(x):
+            # carry mesh-aware shardings (e.g. ZeRO-1 moments) so restore
+            # materializes directly into the sharded layout; plain
+            # single-device placements restore uncommitted, as before
+            sh = getattr(x, "sharding", None)
+            sh = sh if isinstance(sh, NamedSharding) else None
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+
+        return ckpt.restore(os.path.abspath(path), jax.tree.map(as_struct,
+                                                                template))
     return ckpt.restore(os.path.abspath(path))

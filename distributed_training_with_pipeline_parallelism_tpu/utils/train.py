@@ -51,6 +51,27 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     return train_step
 
 
+def init_sharded_opt_state(optimizer: optax.GradientTransformation,
+                           params: Pytree, mesh: Mesh) -> Pytree:
+    """ZeRO-1 init without the replicated peak: compute the state's shape
+    tree abstractly, derive FSDP placements, and jit ``optimizer.init``
+    with those out_shardings so the moments are born sharded."""
+    from jax.sharding import NamedSharding
+
+    from ..parallel.fsdp import fsdp_specs
+    from ..parallel.mesh import DATA_AXIS
+
+    n = mesh.shape.get(DATA_AXIS, 1)
+    if n <= 1:
+        return optimizer.init(params)
+    shapes = jax.eval_shape(optimizer.init, params)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                             fsdp_specs(shapes, n),
+                             is_leaf=lambda x: not isinstance(
+                                 x, (dict, list, tuple)))
+    return jax.jit(optimizer.init, out_shardings=shardings)(params)
+
+
 def shard_opt_state(opt_state: Pytree, mesh: Mesh) -> Pytree:
     """ZeRO-1: place optimizer-state leaves (Adam moments etc.) sharded over
     the mesh's 'data' axis, each on its largest divisible dimension
@@ -73,13 +94,27 @@ def shard_opt_state(opt_state: Pytree, mesh: Mesh) -> Pytree:
 def adamw(learning_rate: float = 3e-4, weight_decay: float = 0.01,
           warmup_steps: int = 100, total_steps: int = 10000,
           max_grad_norm: float = 1.0) -> optax.GradientTransformation:
-    """Standard LM recipe: global-norm clip + AdamW + linear-warmup cosine."""
+    """Standard LM recipe: global-norm clip + AdamW + linear-warmup cosine.
+
+    Weight decay applies to projection matrices only — biases, norm
+    scales/biases, and embeddings are excluded, the standard LM practice
+    (decaying LayerNorm scales toward zero actively hurts). Leaf ndim
+    cannot distinguish these in the stacked-layer layout (a per-layer bias
+    stack is 2-D), so the mask keys off this framework's naming
+    convention: matrices live under "w" (linear/attention/router) and
+    "w1"/"w2" (MoE expert stacks)."""
     lr = optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=learning_rate, warmup_steps=warmup_steps,
         decay_steps=max(total_steps, warmup_steps + 1))
+
+    def decay_mask(params):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: getattr(path[-1], "key", None) in ("w", "w1", "w2"),
+            params)
+
     return optax.chain(
         optax.clip_by_global_norm(max_grad_norm),
-        optax.adamw(lr, weight_decay=weight_decay),
+        optax.adamw(lr, weight_decay=weight_decay, mask=decay_mask),
     )
 
 
@@ -133,9 +168,12 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
     step_fn = make_train_step(cfg, mesh, sched, optimizer, moe=moe,
                               sp_attn_impl=sp_attn_impl,
                               tp_vocab_parallel=tp_vocab_parallel)
-    opt_state = optimizer.init(params)
     if zero1:
-        opt_state = shard_opt_state(opt_state, mesh)
+        # init directly INTO the sharded layout: the replicated moments
+        # never materialize, so the ZeRO-1 memory ceiling holds at init too
+        opt_state = init_sharded_opt_state(optimizer, params, mesh)
+    else:
+        opt_state = optimizer.init(params)
 
     start_step = 0
     if resume and checkpoint_dir:
@@ -145,11 +183,10 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
             state = restore_checkpoint(path, template={
                 "params": params, "opt_state": opt_state,
                 "step": jnp.asarray(0)})
+            # the restore template carries the live shardings (see
+            # checkpoint.restore_checkpoint), so a zero1 run restores its
+            # moments directly into the sharded layout
             params, opt_state = state["params"], state["opt_state"]
-            if zero1:
-                # the restore template carries no shardings; re-apply so a
-                # resumed run keeps the ZeRO-1 memory footprint
-                opt_state = shard_opt_state(opt_state, mesh)
             start_step = int(state["step"]) + 1
             if skip_data_on_resume:
                 for _ in range(start_step):

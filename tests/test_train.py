@@ -120,3 +120,41 @@ def test_fit_checkpoint_resume_and_metrics(tmp_path):
         set(ln) == {"step", "loss", "tokens_per_sec", "elapsed_s"}
         for ln in lines)
     assert [ln["step"] for ln in lines] == [0, 2, 3]
+
+
+def test_adamw_decays_matrices_only():
+    """Weight decay must not touch biases/norm scales (standard LM
+    practice): with zero gradients, only ndim>=2 leaves shrink."""
+    cfg = dtpp.ModelConfig(dim=16, n_layers=2, n_heads=2, vocab_size=32,
+                           ffn_dim=32)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    opt = train.adamw(learning_rate=1e-2, weight_decay=0.1, warmup_steps=0,
+                      total_steps=10)
+    state = opt.init(params)
+    zero_grads = jax.tree.map(jnp.zeros_like, params)
+    updates, _ = opt.update(zero_grads, state, params)
+    moved = jax.tree.map(lambda u: float(jnp.max(jnp.abs(u))) > 0, updates)
+    for path, did_move in jax.tree_util.tree_leaves_with_path(moved):
+        is_matrix = getattr(path[-1], "key", None) in ("w", "w1", "w2")
+        assert did_move == is_matrix, path
+
+
+def test_adamw_decay_set_matches_golden_list():
+    """Independent of the mask's own predicate: the exact set of decayed
+    leaves for a gpt2 tree, written out by hand."""
+    cfg = dtpp.ModelConfig(dim=16, n_layers=2, n_heads=2, vocab_size=32,
+                           ffn_dim=32, arch="gpt2")
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    opt = train.adamw(learning_rate=1e-2, weight_decay=0.1, warmup_steps=0,
+                      total_steps=10)
+    updates, _ = opt.update(jax.tree.map(jnp.zeros_like, params),
+                            opt.init(params), params)
+    decayed = {jax.tree_util.keystr(p)
+               for p, u in jax.tree_util.tree_leaves_with_path(updates)
+               if float(jnp.max(jnp.abs(u))) > 0}
+    assert decayed == {
+        "['layers']['attn']['q']['w']", "['layers']['attn']['k']['w']",
+        "['layers']['attn']['v']['w']", "['layers']['attn']['o']['w']",
+        "['layers']['lin1']['w']", "['layers']['lin2']['w']",
+        "['head']['out']['w']",
+    }, sorted(decayed)
