@@ -58,14 +58,11 @@ def _attend_cached(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     query at global position i iff j <= i — which simultaneously enforces
     causality inside the new block and masks the unwritten cache tail.
     """
+    from ..ops.attention import band_mask
     k_cache, v_cache = gqa_expand(k_cache, v_cache, n_heads)
     s, t = q.shape[1], k_cache.shape[1]
-    q_pos = offset + jnp.arange(s)[:, None]
-    k_pos = jnp.arange(t)[None, :]
-    mask = k_pos <= q_pos
-    if window is not None:
-        mask &= q_pos - k_pos < window
-    out = scaled_dot_attention(q, k_cache, v_cache, mask[None, None])
+    mask = band_mask(s, t, window, q_offset=offset)[None, None]
+    out = scaled_dot_attention(q, k_cache, v_cache, mask)
     return out.reshape(q.shape[0], s, -1)
 
 

@@ -70,6 +70,21 @@ def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
     return out.astype(x.dtype)  # rotation runs in f32; don't promote bf16 activations
 
 
+def band_mask(n_q: int, n_k: int, window: Optional[int] = None,
+              q_offset=0) -> jax.Array:
+    """Causal [n_q, n_k] mask, optionally banded to a sliding window: query
+    i (at global position q_offset + i) sees keys in
+    ``[pos - window + 1, pos]``. The single source of the window
+    convention — used by the dense train path, the flash kernel's backward,
+    and the KV-cache decode path."""
+    iq = q_offset + jnp.arange(n_q)[:, None]
+    ik = jnp.arange(n_k)[None, :]
+    mask = iq >= ik
+    if window is not None:
+        mask &= iq - ik < window
+    return mask
+
+
 def gqa_expand(k: jax.Array, v: jax.Array, n_heads: int):
     """Repeat kv heads up to n_heads for grouped-query attention (no-op for MHA)."""
     n_kv = k.shape[2]
@@ -136,23 +151,14 @@ def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
     from .collectives import tp_attention_inputs, tp_output_projection
     q_in, kv_in = tp_attention_inputs(q_in, kv_in, tp_axis)
     q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles)
-    if flash and window is not None:
-        raise NotImplementedError(
-            "the flash kernel has no sliding-window band mask yet; "
-            "long-window models must run with use_flash_attention=False")
     if flash:
         from .pallas_attention import flash_attention
-        out = flash_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, window=window)
     else:
         mask = None
         if causal:
             s = q_in.shape[1]
-            iq = jnp.arange(s)[:, None]
-            ik = jnp.arange(s)[None, :]
-            mask = iq >= ik
-            if window is not None:
-                mask &= iq - ik < window
-            mask = mask[None, None]
+            mask = band_mask(s, s, window)[None, None]
         out = scaled_dot_attention(q, k, v, mask)
     out = out.reshape(q_in.shape[0], q_in.shape[1], -1)
     return tp_output_projection(params["o"], out, tp_axis)

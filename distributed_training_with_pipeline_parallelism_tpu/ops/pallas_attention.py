@@ -39,7 +39,8 @@ def _use_interpret() -> bool:
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                      causal: bool, scale: float, seq_len: int):
+                      causal: bool, scale: float, seq_len: int,
+                      window: Optional[int]):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale  # [block_q, dh]
     block_q = q.shape[0]
@@ -51,6 +52,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         n_kv_live = jax.lax.min(n_kv, ((qi + 1) * block_q + block_k - 1) // block_k)
     else:
         n_kv_live = n_kv
+    if window is not None:
+        # lowest k block the FIRST query row of this block can still see:
+        # its oldest visible key is qi*block_q - (window - 1)
+        kv_start = jax.lax.max(0, (qi * block_q - (window - 1)) // block_k)
+    else:
+        kv_start = 0
 
     def body(ki, carry):
         m, l, acc = carry
@@ -60,7 +67,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            keep = rows >= cols
+            if window is not None:
+                keep &= rows - cols < window
+            s = jnp.where(keep, s, NEG_INF)
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m, m_blk)
         p = jnp.exp(s - m_new[:, None])
@@ -74,13 +84,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc0 = jnp.zeros((block_q, dh), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_kv_live, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(kv_start, n_kv_live, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
 
 
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
-               block_q: int, block_k: int) -> jax.Array:
+               block_q: int, block_k: int,
+               window: Optional[int] = None) -> jax.Array:
     """q, k, v: [bh, s, dh] -> [bh, s, dh]."""
     bh, s, dh = q.shape
     scale = 1.0 / (dh ** 0.5)
@@ -88,7 +99,8 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     block_k = min(block_k, s)
     grid = (bh, pl.cdiv(s, block_q))
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
-                               causal=causal, scale=scale, seq_len=s)
+                               causal=causal, scale=scale, seq_len=s,
+                               window=window)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -103,31 +115,32 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     )(q, k, v)
 
 
-def _dense_attention(q, k, v, causal):
+def _dense_attention(q, k, v, causal, window=None):
     """Reference/backward path in plain XLA (f32 accumulation)."""
     dh = q.shape[-1]
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) / (dh ** 0.5)
     if causal:
-        n, nk = s.shape[-2], s.shape[-1]
-        mask = jnp.arange(n)[:, None] >= jnp.arange(nk)[None, :]
-        s = jnp.where(mask[None], s, NEG_INF)
+        from .attention import band_mask
+        s = jnp.where(band_mask(s.shape[-2], s.shape[-1], window)[None],
+                      s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, block_q, block_k):
-    return _flash_fwd(q, k, v, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, window):
+    return _flash_fwd(q, k, v, causal, block_q, block_k, window)
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
-    return _flash_fwd(q, k, v, causal, block_q, block_k), (q, k, v)
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, window):
+    return _flash_fwd(q, k, v, causal, block_q, block_k, window), (q, k, v)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, res, g):
+def _flash_vjp_bwd(causal, block_q, block_k, window, res, g):
     q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _dense_attention(q, k, v, causal), q, k, v)
+    _, vjp = jax.vjp(
+        lambda q, k, v: _dense_attention(q, k, v, causal, window), q, k, v)
     return vjp(g)
 
 
@@ -136,16 +149,23 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, block_q: int = 256,
-                    block_k: int = 256) -> jax.Array:
+                    block_k: int = 256,
+                    window: Optional[int] = None) -> jax.Array:
     """Fused attention: q, k, v [batch, seq, heads, head_dim] -> same shape.
 
     Drop-in replacement for the dense attention inside
     ``ops.attention.mha_apply`` (GQA repeat must happen before the call).
+    ``window`` (requires ``causal``) applies the Mistral sliding-window
+    band: the kernel skips K/V blocks entirely outside
+    ``[i - window + 1, i]``, so long-sequence forward cost scales with the
+    window, not the sequence.
     """
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal attention and window >= 1")
     b, s, h, dh = q.shape
 
     def flat(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, dh)
 
-    out = _flash(flat(q), flat(k), flat(v), causal, block_q, block_k)
+    out = _flash(flat(q), flat(k), flat(v), causal, block_q, block_k, window)
     return out.reshape(b, h, s, dh).transpose(0, 2, 1, 3)

@@ -68,3 +68,44 @@ def test_model_with_flash_flag():
     a = tfm.transformer_apply(cfg, params, tokens)
     b = tfm.transformer_apply(ref_cfg, params, tokens)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("window,block", [(4, 8), (8, 8), (3, 16), (20, 8)])
+def test_flash_sliding_window_matches_dense(window, block):
+    """Band-pruned flash vs the dense windowed mask: fwd and grads, with
+    windows below/at/above the block size and crossing block boundaries."""
+    b, s, h, dh = 2, 32, 2, 8
+    kq, kk, kv, kg = jax.random.split(jax.random.key(0), 4)
+    q = jax.random.normal(kq, (b, s, h, dh))
+    k = jax.random.normal(kk, (b, s, h, dh))
+    v = jax.random.normal(kv, (b, s, h, dh))
+
+    def dense(q, k, v):
+        iq = jnp.arange(s)[:, None]
+        ik = jnp.arange(s)[None, :]
+        mask = (iq >= ik) & (iq - ik < window)
+        from distributed_training_with_pipeline_parallelism_tpu.ops.attention import (
+            scaled_dot_attention)
+        return scaled_dot_attention(q, k, v, mask[None, None])
+
+    got = flash_attention(q, k, v, causal=True, block_q=block, block_k=block,
+                          window=window)
+    want = dense(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+    g = jax.random.normal(kg, got.shape)
+    gf = jax.grad(lambda q, k, v: jnp.vdot(
+        flash_attention(q, k, v, causal=True, block_q=block, block_k=block,
+                        window=window), g), argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: jnp.vdot(dense(q, k, v), g),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_flash_window_requires_causal():
+    q = jnp.zeros((1, 8, 1, 4))
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, q, q, causal=False, window=4)
