@@ -40,13 +40,13 @@ def _use_interpret() -> bool:
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
                       causal: bool, scale: float, seq_len: int,
-                      window: Optional[int]):
+                      true_len: int, window: Optional[int]):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale  # [block_q, dh]
     block_q = q.shape[0]
     dh = q.shape[1]
 
-    n_kv = pl.cdiv(seq_len, block_k)
+    n_kv = pl.cdiv(seq_len, block_k)  # seq_len is padded to a block multiple
     if causal:
         # highest k block that the last query row of this block can see
         n_kv_live = jax.lax.min(n_kv, ((qi + 1) * block_q + block_k - 1) // block_k)
@@ -64,13 +64,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [bq, bk]
+        rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        keep = cols < true_len  # bounds: keys in the ragged padding are dead
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            keep = rows >= cols
+            keep &= rows >= cols
             if window is not None:
                 keep &= rows - cols < window
-            s = jnp.where(keep, s, NEG_INF)
+        s = jnp.where(keep, s, NEG_INF)
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m, m_blk)
         p = jnp.exp(s - m_new[:, None])
@@ -92,27 +93,36 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
                block_q: int, block_k: int,
                window: Optional[int] = None) -> jax.Array:
-    """q, k, v: [bh, s, dh] -> [bh, s, dh]."""
+    """q, k, v: [bh, s, dh] -> [bh, s, dh]. Ragged s (not a block multiple)
+    is zero-padded up front; padded key columns are masked dead in-kernel
+    and padded query rows are sliced off the output."""
     bh, s, dh = q.shape
     scale = 1.0 / (dh ** 0.5)
     block_q = min(block_q, s)
     block_k = min(block_k, s)
-    grid = (bh, pl.cdiv(s, block_q))
+    import math
+    blk = math.lcm(block_q, block_k)
+    s_pad = -(-s // blk) * blk
+    if s_pad != s:
+        pad = ((0, 0), (0, s_pad - s), (0, 0))
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
+    grid = (bh, s_pad // block_q)
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
-                               causal=causal, scale=scale, seq_len=s,
-                               window=window)
-    return pl.pallas_call(
+                               causal=causal, scale=scale, seq_len=s_pad,
+                               true_len=s, window=window)
+    out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, s, dh), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, s, dh), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),
         interpret=_use_interpret(),
     )(q, k, v)
+    return out[:, :s, :]
 
 
 def _dense_attention(q, k, v, causal, window=None):
@@ -157,8 +167,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``ops.attention.mha_apply`` (GQA repeat must happen before the call).
     ``window`` (requires ``causal``) applies the Mistral sliding-window
     band: the kernel skips K/V blocks entirely outside
-    ``[i - window + 1, i]``, so long-sequence forward cost scales with the
-    window, not the sequence.
+    ``[i - window + 1, i]``, so long-sequence forward *compute* scales with
+    the window. K/V VMEM residency still scales with the sequence (the
+    whole [s, dh] K/V maps in per (batch, head)); truly long sequences
+    should shard over a 'seq' mesh axis instead (ring attention).
     """
     if window is not None and (not causal or window < 1):
         raise ValueError("window requires causal attention and window >= 1")

@@ -101,6 +101,16 @@ def write_token_file(path: str, tokens: np.ndarray,
     np.asarray(tokens, dtype=dtype).tofile(path)
 
 
+def encode_text_file(text_path: str, out_path: str) -> int:
+    """Byte-level "tokenize" a UTF-8 text file into the packed format
+    (vocab 256, no external tokenizer): the zero-dependency way to train on
+    real text. Returns the token (byte) count. Pair with
+    ``ModelConfig(vocab_size=256)``."""
+    data = np.fromfile(text_path, dtype=np.uint8)
+    write_token_file(out_path, data)
+    return int(data.size)
+
+
 def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> Optional[NamedSharding]:
     """Sharding for [B, S] batches: batch dim split over the mesh's data
     axis (replicated over the other axes). Returns None if the mesh has no

@@ -72,6 +72,9 @@ def main():
     ap.add_argument("--ffn", type=int, default=0)
     ap.add_argument("--layers", type=int, default=0)
     ap.add_argument("--heads", type=int, default=0)
+    ap.add_argument("--vocab", type=int, default=0,
+                    help="override vocab size (e.g. 256 for byte-level "
+                         "corpora from encode_text_file)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--data-file", default="",
                     help="flat binary token file (uint16 ids); default is "
@@ -139,7 +142,7 @@ def main():
 
     overrides = {k: v for k, v in dict(
         dim=args.dim, ffn_dim=args.ffn, n_layers=args.layers,
-        n_heads=args.heads,
+        n_heads=args.heads, vocab_size=args.vocab,
     ).items() if v}
     overrides["dtype"] = args.dtype
     if args.flash:

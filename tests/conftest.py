@@ -18,3 +18,9 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the suite is compile-dominated (hundreds of
+# tiny jitted programs); re-runs hit the cache and finish in a fraction of
+# the cold time. Keyed by HLO hash, so code changes invalidate safely.
+jax.config.update("jax_compilation_cache_dir", "/tmp/dtpp_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -193,3 +193,19 @@ def test_native_loader_concurrent_close_while_next_blocked(tmp_path):
         t.join(timeout=10)
         assert not t.is_alive(), "consumer thread hung after close()"
         assert results, "consumer never observed the close"
+
+
+def test_encode_text_file_byte_level(tmp_path):
+    src = tmp_path / "corpus.txt"
+    src.write_text("hello pipeline world! " * 50)
+    out = tmp_path / "corpus.bin"
+    from distributed_training_with_pipeline_parallelism_tpu.utils.data import (
+        encode_text_file)
+    n = encode_text_file(src, out)
+    assert n == len("hello pipeline world! ") * 50
+    ds = TokenFileDataset(out, seq_length=16)
+    toks, tgts = ds.sample(4)
+    assert toks.max() < 256
+    # decode a crop back to text: it must be a substring of the corpus
+    text = bytes(toks[0].tolist()).decode()
+    assert text in "hello pipeline world! " * 51

@@ -109,3 +109,26 @@ def test_flash_window_requires_causal():
     q = jnp.zeros((1, 8, 1, 4))
     with pytest.raises(ValueError, match="causal"):
         flash_attention(q, q, q, causal=False, window=4)
+
+
+@pytest.mark.parametrize("s,block,window", [
+    (12, 8, 5),      # the reproduced ragged corruption case
+    (29, 8, None),   # ragged, plain causal
+    (29, 8, 7),
+    (13, 16, None),  # seq smaller than the block
+])
+def test_flash_ragged_seq_lengths(s, block, window):
+    """Sequence lengths that do not divide the block size: padded keys must
+    be dead and padded query rows sliced off."""
+    b, h, dh = 2, 2, 8
+    kq, kk, kv = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(kq, (b, s, h, dh))
+    k = jax.random.normal(kk, (b, s, h, dh))
+    v = jax.random.normal(kv, (b, s, h, dh))
+    from distributed_training_with_pipeline_parallelism_tpu.ops.attention import (
+        band_mask, scaled_dot_attention)
+    want = scaled_dot_attention(q, k, v, band_mask(s, s, window)[None, None])
+    got = flash_attention(q, k, v, causal=True, block_q=block, block_k=block,
+                          window=window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
