@@ -106,6 +106,20 @@ std::vector<Order> interleaved_order(int D, int V, int M) {
   return orders;
 }
 
+// BFS breadth-first pipeline (arXiv:2211.05953): GPipe generalized to V
+// virtual stages with wrap placement — all forwards in (v, m) lexicographic
+// order, then all backwards with v reversed. Mirrors schedules.bfs_order.
+std::vector<Order> bfs_order(int D, int V, int M) {
+  std::vector<Order> orders(D);
+  for (int d = 0; d < D; ++d) {
+    for (int v = 0; v < V; ++v)
+      for (int m = 0; m < M; ++m) orders[d].push_back({v * D + d, OP_F, m});
+    for (int v = V - 1; v >= 0; --v)
+      for (int m = 0; m < M; ++m) orders[d].push_back({v * D + d, OP_B, m});
+  }
+  return orders;
+}
+
 // ZB-H1 (arXiv:2401.10241): dgrad/wgrad split backward; stage 0 has no B
 // (nothing upstream to send a cotangent to) — its W does the full
 // parameter+embedding backward. Mirrors schedules.zb_h1_order.
@@ -210,6 +224,8 @@ int dtpp_compile_schedule(const char* name, int D, int V, int M,
     if (M % num_rounds != 0)
       return fail(err, errlen, "Interleaved1F1B requires n_microbatches % num_rounds == 0");
     orders = interleaved_order(D, V, M);
+  } else if (sname == "BFS") {
+    orders = bfs_order(D, V, M);
   } else if (sname == "ZBH1") {
     if (V != 1) return fail(err, errlen, "ZBH1 supports a single stage per device");
     if (D < 2) return fail(err, errlen, "ZBH1 requires n_devices >= 2");

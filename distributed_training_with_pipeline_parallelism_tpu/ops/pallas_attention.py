@@ -24,6 +24,7 @@ the same code path.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -64,14 +65,15 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [bq, bk]
-        rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        keep = cols < true_len  # bounds: keys in the ragged padding are dead
-        if causal:
-            keep &= rows >= cols
-            if window is not None:
-                keep &= rows - cols < window
-        s = jnp.where(keep, s, NEG_INF)
+        if causal or true_len != seq_len:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            keep = cols < true_len  # keys in the ragged padding are dead
+            if causal:
+                keep &= rows >= cols
+                if window is not None:
+                    keep &= rows - cols < window
+            s = jnp.where(keep, s, NEG_INF)
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m, m_blk)
         p = jnp.exp(s - m_new[:, None])
@@ -100,7 +102,6 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     scale = 1.0 / (dh ** 0.5)
     block_q = min(block_q, s)
     block_k = min(block_k, s)
-    import math
     blk = math.lcm(block_q, block_k)
     s_pad = -(-s // blk) * blk
     if s_pad != s:

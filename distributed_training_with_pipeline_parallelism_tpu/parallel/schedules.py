@@ -155,6 +155,34 @@ def interleaved_order(n_devices: int, n_virtual: int,
     return orders
 
 
+def bfs_order(n_devices: int, n_virtual: int,
+              n_microbatches: int) -> List[List[Action]]:
+    """BFS (breadth-first) pipeline: GPipe generalized to V virtual stages
+    per device with wrap placement (Lamy-Poirier, arXiv:2211.05953).
+
+    Per device: all forwards in (virtual, microbatch) lexicographic order —
+    every microbatch sweeps virtual stage v before any touches v+1 — then
+    all backwards with the virtual order reversed. With V == 1 this *is*
+    GPipe's fill-drain. Versus Interleaved-1F1B it keeps GPipe's simple
+    all-F-then-all-B structure (activation memory O(M*V), no steady-state
+    interleaving) while shrinking the bubble the same way: per-device work
+    grows to 2MV unit ticks against the same ~2(D-1) ramp.
+
+    Beyond-parity: the reference's three schedules (SURVEY.md U2-U4) do not
+    include BFS; it completes the depth-first (interleaved) vs breadth-first
+    axis of the virtual-stage design space.
+    """
+    D, V, M = n_devices, n_virtual, n_microbatches
+    orders = []
+    for d in range(D):
+        acts = [Action(v * D + d, F, m)
+                for v in range(V) for m in range(M)]
+        acts += [Action(v * D + d, B, m)
+                 for v in reversed(range(V)) for m in range(M)]
+        orders.append(acts)
+    return orders
+
+
 def zb_h1_order(n_devices: int, n_microbatches: int) -> List[List[Action]]:
     """ZB-H1 zero-bubble schedule (Qi et al., arXiv:2401.10241): the full
     backward is split into an input-grad half ``B`` (on the critical path —
@@ -221,6 +249,8 @@ def build_order(name: str, n_devices: int, n_virtual: int,
         return one_f_one_b_order(n_devices, n_microbatches)
     if name == "Interleaved1F1B":
         return interleaved_order(n_devices, n_virtual, n_microbatches)
+    if name == "BFS":
+        return bfs_order(n_devices, n_virtual, n_microbatches)
     raise ScheduleError(f"unknown schedule {name!r}")
 
 
@@ -592,7 +622,7 @@ def analytic_bubble_fraction(name: str, n_devices: int, n_virtual: int,
 
     GPipe / 1F1B: (D-1)/(M + D - 1) — the classic fill/drain bubble (1F1B
     matches GPipe's bubble; its win is activation memory, SURVEY.md §6 note).
-    Interleaved: warmup/cooldown offsets stay proportional to D-1 while
+    Interleaved / BFS: warmup/cooldown offsets stay proportional to D-1 while
     per-device work grows to 2MV ticks -> (D-1)/(M*V + D-1).
     ZB-H1: per-device work is 3M unit ticks (F + dgrad + wgrad) against the
     same ~(D-1) ramp -> (D-1)/(3M + D-1); with dgrad~wgrad~F~1 this is the
@@ -602,7 +632,7 @@ def analytic_bubble_fraction(name: str, n_devices: int, n_virtual: int,
     D, M = n_devices, n_microbatches
     if name == "ZBH1":
         return (D - 1) / (3 * M + D - 1)
-    V = n_virtual if name == "Interleaved1F1B" else 1
+    V = n_virtual if name in ("Interleaved1F1B", "BFS") else 1
     return (D - 1) / (M * V + D - 1)
 
 

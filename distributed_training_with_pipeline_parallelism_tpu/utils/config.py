@@ -103,8 +103,9 @@ class ScheduleConfig:
 
     ``name`` in {"GPipe", "1F1B", "Interleaved1F1B"} — the same strings the
     reference dispatches on (``LLMsDistributedTrainingHelper.py:215-220``) —
-    or "ZBH1", the beyond-parity zero-bubble schedule with split
-    dgrad/wgrad backward (arXiv:2401.10241).
+    or the beyond-parity schedules "ZBH1" (zero-bubble with split
+    dgrad/wgrad backward, arXiv:2401.10241) and "BFS" (breadth-first
+    virtual-stage GPipe, arXiv:2211.05953).
     ``n_microbatches`` defaults to the reference's fixed 4 (``:214``).
     ``n_virtual`` is the number of virtual stages per device; the reference picks
     2 iff ``schedule=='Interleaved1F1B' and n_layers % (world_size*2)==0`` else
@@ -120,7 +121,7 @@ class ScheduleConfig:
             raise ValueError(f"unknown schedule {self.name!r}; expected one of {SCHEDULE_NAMES}")
 
 
-SCHEDULE_NAMES = ("GPipe", "1F1B", "Interleaved1F1B", "ZBH1")
+SCHEDULE_NAMES = ("GPipe", "1F1B", "Interleaved1F1B", "ZBH1", "BFS")
 
 
 def virtual_stages_for(schedule_name: str, n_layers: int, n_pipe: int) -> int:

@@ -8,7 +8,9 @@ environment force-selects the axon TPU plugin via JAX_PLATFORMS, so we also
 override through jax.config (env alone is not enough here).
 """
 
+import getpass
 import os
+import tempfile
 
 os.environ["XLA_FLAGS"] = (
     "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", "")
@@ -22,5 +24,9 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: the suite is compile-dominated (hundreds of
 # tiny jitted programs); re-runs hit the cache and finish in a fraction of
 # the cold time. Keyed by HLO hash, so code changes invalidate safely.
-jax.config.update("jax_compilation_cache_dir", "/tmp/dtpp_jax_cache")
+# User-scoped path: a world-shared fixed dir breaks on multi-user machines
+# (first user owns it; everyone else's writes fail silently).
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(tempfile.gettempdir(), f"dtpp_jax_cache_{getpass.getuser()}"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

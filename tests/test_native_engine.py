@@ -16,6 +16,7 @@ pytestmark = pytest.mark.skipif(not native.native_available(),
     ("1F1B", 4, 1, 4), ("1F1B", 4, 1, 16), ("1F1B", 8, 1, 8),
     ("Interleaved1F1B", 2, 2, 4), ("Interleaved1F1B", 4, 2, 8),
     ("Interleaved1F1B", 2, 4, 8), ("Interleaved1F1B", 4, 1, 4),
+    ("BFS", 2, 2, 4), ("BFS", 4, 2, 8), ("BFS", 4, 3, 2),
 ])
 def test_native_matches_python(name, D, V, M):
     py = compile_schedule(name, D, V, M)

@@ -48,6 +48,9 @@ def assert_matches_reference(loss, grads, ref_loss, ref_grads, tol=1e-5):
     ("Interleaved1F1B", 4, 2, 8),
     ("Interleaved1F1B", 2, 4, 4),
     ("Interleaved1F1B", 4, 1, 4),  # degenerate: falls back to 1F1B layout
+    ("BFS", 2, 2, 4),
+    ("BFS", 4, 2, 4),
+    ("BFS", 2, 4, 2),
 ])
 def test_pipeline_matches_single_device(problem, name, D, V, M):
     params, tokens, targets, ref_loss, ref_grads = problem

@@ -57,6 +57,7 @@ def test_interleaved_v1_degenerates_to_1f1b():
     ("1F1B", 2, 1, 4), ("1F1B", 4, 1, 4), ("1F1B", 4, 1, 8),
     ("Interleaved1F1B", 2, 2, 4), ("Interleaved1F1B", 4, 2, 4),
     ("Interleaved1F1B", 4, 2, 8), ("Interleaved1F1B", 2, 3, 6),
+    ("BFS", 2, 2, 4), ("BFS", 4, 2, 8), ("BFS", 4, 3, 2), ("BFS", 8, 2, 4),
 ])
 def test_compile_and_validate(name, D, V, M):
     cs = compile_schedule(name, D, V, M)
@@ -76,6 +77,35 @@ def test_compile_and_validate(name, D, V, M):
     n_fwd = int(np.sum(tbl[:, :, sch.COL_FWD_M] >= 0))
     n_bwd = int(np.sum(tbl[:, :, sch.COL_BWD_M] >= 0))
     assert n_fwd == S * M and n_bwd == S * M
+
+
+def test_bfs_v1_degenerates_to_gpipe():
+    # BFS with one virtual stage per device IS GPipe's fill-drain
+    assert build_order("BFS", 4, 1, 4) == build_order("GPipe", 4, 1, 4)
+
+
+def test_bfs_breadth_first_sweep():
+    # every microbatch finishes virtual stage v before any enters v+1,
+    # and backwards run in reverse virtual order
+    D, V, M = 2, 3, 4
+    orders = build_order("BFS", D, V, M)
+    validate_order(orders, D, V, M)
+    for d, order in enumerate(orders):
+        fwd_v = [a.stage // D for a in order if a.op == F]
+        assert fwd_v == sorted(fwd_v), f"device {d}: forward not breadth-first"
+        bwd_v = [a.stage // D for a in order if a.op == B]
+        assert bwd_v == sorted(bwd_v, reverse=True), f"device {d}"
+
+
+def test_bfs_shrinks_bubble_like_interleaved():
+    # unit-cost bubble: BFS with V virtual stages matches the analytic
+    # (D-1)/(MV + D-1) and beats GPipe's (D-1)/(M + D-1)
+    D, V, M = 4, 2, 8
+    b_gp = simulated_bubble(compile_schedule("GPipe", D, 1, M), 1.0, 1.0)
+    b_bfs = simulated_bubble(compile_schedule("BFS", D, V, M), 1.0, 1.0)
+    assert b_bfs["bubble_fraction"] < b_gp["bubble_fraction"]
+    ana = analytic_bubble_fraction("BFS", D, V, M)
+    assert b_bfs["bubble_fraction"] == pytest.approx(ana, rel=0.15)
 
 
 def test_gpipe_makespan_matches_analytic():
