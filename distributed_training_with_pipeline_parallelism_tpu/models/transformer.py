@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import mha_apply, mha_init, rope_frequencies
-from ..ops.layers import (embedding_apply, embedding_init,
+from ..ops.layers import (dropout_apply, embedding_apply, embedding_init,
                           layer_norm_apply, layer_norm_init, linear_apply,
                           linear_init, rms_norm_apply, rms_norm_init,
                           select_xent)
@@ -73,35 +73,58 @@ def layer_init(key: jax.Array, cfg: ModelConfig) -> Dict:
 
 def layer_apply(cfg: ModelConfig, params: Dict, h: jax.Array,
                 rope_angles: Optional[jax.Array] = None,
-                tp_axis: Optional[str] = None, tp_size: int = 1) -> jax.Array:
+                tp_axis: Optional[str] = None, tp_size: int = 1,
+                rng: Optional[jax.Array] = None) -> jax.Array:
     """One decoder block. With ``tp_axis`` set the block runs Megatron
     tensor-parallel inside a manual-SPMD region: weight leaves are local
     shards (attention heads and FFN hidden dim column-split ``tp_size``
     ways), norms replicated, and the two row-parallel projections complete
-    with a psum (see :mod:`..ops.collectives`)."""
+    with a psum (see :mod:`..ops.collectives`).
+
+    ``rng`` (train mode) enables dropout at the torch sites: attention
+    probabilities inside each MHA, each residual branch, and the FFN's inner
+    activation (``nn.TransformerDecoderLayer``'s dropout/dropout1/2/3 for the
+    ref arch; GPT-2's attn/resid dropout). Each site folds a distinct stream
+    from ``rng``, so one per-layer key determines every mask."""
     fl = cfg.use_flash_attention
     heads = cfg.n_heads // tp_size
+    p = cfg.dropout
+
+    def site(i: int) -> Optional[jax.Array]:
+        return None if rng is None else jax.random.fold_in(rng, i)
+
     if cfg.arch == "ref_decoder":
         mem = h  # the reference calls layer(h, h): memory is the layer's input
-        x = layer_norm_apply(params["ln1"], h + mha_apply(
-            params["self_attn"], h, h, heads, flash=fl, tp_axis=tp_axis))
-        x = layer_norm_apply(params["ln2"], x + mha_apply(
-            params["cross_attn"], x, mem, heads, flash=fl, tp_axis=tp_axis))
+        sa = mha_apply(params["self_attn"], h, h, heads, flash=fl,
+                       tp_axis=tp_axis, dropout_rate=p, dropout_rng=site(0))
+        x = layer_norm_apply(params["ln1"], h + dropout_apply(sa, p, site(1)))
+        ca = mha_apply(params["cross_attn"], x, mem, heads, flash=fl,
+                       tp_axis=tp_axis, dropout_rate=p, dropout_rng=site(2))
+        x = layer_norm_apply(params["ln2"], x + dropout_apply(ca, p, site(3)))
         ff = _ffn_out(params["lin2"],
-                      jax.nn.relu(linear_apply(params["lin1"], _tp_in(x, tp_axis))),
+                      dropout_apply(
+                          jax.nn.relu(linear_apply(params["lin1"],
+                                                   _tp_in(x, tp_axis))),
+                          p, site(4)),
                       tp_axis)
-        return layer_norm_apply(params["ln3"], x + ff)
+        return layer_norm_apply(params["ln3"], x + dropout_apply(ff, p, site(5)))
     if cfg.arch == "gpt2":
         a = layer_norm_apply(params["ln1"], h)
-        h = h + mha_apply(params["attn"], a, a, heads, causal=cfg.causal,
-                          flash=fl, tp_axis=tp_axis)
-        return mlp_block(cfg, params, h, tp_axis=tp_axis)
+        attn = mha_apply(params["attn"], a, a, heads, causal=cfg.causal,
+                         flash=fl, tp_axis=tp_axis, dropout_rate=p,
+                         dropout_rng=site(0))
+        h = h + dropout_apply(attn, p, site(1))
+        return mlp_block(cfg, params, h, tp_axis=tp_axis, rng=site(2),
+                         dropout=p)
     if cfg.arch == "llama":
         a = rms_norm_apply(params["rms1"], h, cfg.rms_eps)
-        h = h + mha_apply(params["attn"], a, a, heads, causal=cfg.causal,
-                          rope_angles=rope_angles, flash=fl, tp_axis=tp_axis,
-                          window=cfg.sliding_window)
-        return mlp_block(cfg, params, h, tp_axis=tp_axis)
+        attn = mha_apply(params["attn"], a, a, heads, causal=cfg.causal,
+                         rope_angles=rope_angles, flash=fl, tp_axis=tp_axis,
+                         window=cfg.sliding_window, dropout_rate=p,
+                         dropout_rng=site(0))
+        h = h + dropout_apply(attn, p, site(1))
+        return mlp_block(cfg, params, h, tp_axis=tp_axis, rng=site(2),
+                         dropout=p)
     raise ValueError(f"unknown arch {cfg.arch!r}")
 
 
@@ -120,21 +143,24 @@ def _ffn_out(params: Dict, z: jax.Array, tp_axis: Optional[str]) -> jax.Array:
 
 
 def mlp_block(cfg: ModelConfig, params: Dict, h: jax.Array,
-              tp_axis: Optional[str] = None) -> jax.Array:
+              tp_axis: Optional[str] = None,
+              rng: Optional[jax.Array] = None, dropout: float = 0.0) -> jax.Array:
     """Post-attention half of a gpt2/llama block (norm + MLP + residual).
 
     Shared between the training path (:func:`layer_apply`) and the KV-cache
-    decode path (:mod:`.generate`) so the two cannot drift."""
+    decode path (:mod:`.generate`, which never passes an rng) so the two
+    cannot drift. ``rng`` applies residual-branch dropout to the MLP output."""
     if cfg.arch == "gpt2":
         m = _tp_in(layer_norm_apply(params["ln2"], h), tp_axis)
-        return h + _ffn_out(params["lin2"],
-                            jax.nn.gelu(linear_apply(params["lin1"], m)),
-                            tp_axis)
+        ff = _ffn_out(params["lin2"],
+                      jax.nn.gelu(linear_apply(params["lin1"], m)),
+                      tp_axis)
+        return h + dropout_apply(ff, dropout, rng)
     m = _tp_in(rms_norm_apply(params["rms2"], h, cfg.rms_eps), tp_axis)
     ff = _ffn_out(params["w2"],
                   jax.nn.silu(linear_apply(params["w1"], m)) * linear_apply(params["w3"], m),
                   tp_axis)
-    return h + ff
+    return h + dropout_apply(ff, dropout, rng)
 
 
 # ---------------------------------------------------------------------------
@@ -162,10 +188,12 @@ def transformer_init(key: jax.Array, cfg: ModelConfig) -> Dict:
     return params
 
 
-def embed_apply(cfg: ModelConfig, embed: Dict, tokens: jax.Array) -> jax.Array:
+def embed_apply(cfg: ModelConfig, embed: Dict, tokens: jax.Array,
+                rng: Optional[jax.Array] = None) -> jax.Array:
     h = embedding_apply(embed["tok"], tokens)
     if cfg.arch == "gpt2":
         h = h + embed["pos"][: tokens.shape[1]]
+        h = dropout_apply(h, cfg.dropout, rng)  # GPT-2 embedding dropout
     return h
 
 
@@ -177,19 +205,31 @@ def _rope(cfg: ModelConfig, seq_len: int) -> Optional[jax.Array]:
 
 
 def body_apply(cfg: ModelConfig, layers: Dict, h: jax.Array,
-               tp_axis: Optional[str] = None, tp_size: int = 1) -> jax.Array:
-    """Run a stack of layers whose leaves are stacked on axis 0 (any count)."""
-    rope = _rope(cfg, h.shape[1])
+               tp_axis: Optional[str] = None, tp_size: int = 1,
+               rng: Optional[jax.Array] = None,
+               layer_offset=0) -> jax.Array:
+    """Run a stack of layers whose leaves are stacked on axis 0 (any count).
 
-    def step(carry, layer_params):
+    ``rng`` (train mode) enables dropout; each layer folds
+    ``layer_offset + i`` from it, where ``layer_offset`` is the stack's first
+    *global* layer index — so masks depend only on (rng, global layer, site),
+    making a pipeline-stage run reproduce exactly the masks of any other
+    stage partitioning of the same model (asserted in tests/test_dropout.py).
+    """
+    rope = _rope(cfg, h.shape[1])
+    n = jax.tree.leaves(layers)[0].shape[0]
+
+    def step(carry, xs):
+        layer_params, i = xs
+        rng_l = None if rng is None else jax.random.fold_in(rng, layer_offset + i)
         return layer_apply(cfg, layer_params, carry, rope,
-                           tp_axis=tp_axis, tp_size=tp_size), None
+                           tp_axis=tp_axis, tp_size=tp_size, rng=rng_l), None
 
     if cfg.remat_layers:
         # rematerialize each layer in backward: activation memory drops from
         # O(layers x intermediates) to O(layers) block inputs
         step = jax.checkpoint(step)
-    out, _ = jax.lax.scan(step, h, layers)
+    out, _ = jax.lax.scan(step, h, (layers, jnp.arange(n)))
     return out
 
 
@@ -205,17 +245,25 @@ def head_apply(cfg: ModelConfig, head: Dict, h: jax.Array) -> jax.Array:
     return linear_apply(head["out"], head_norm_apply(cfg, head, h))
 
 
-def transformer_apply(cfg: ModelConfig, params: Dict, tokens: jax.Array) -> jax.Array:
-    """Full-model forward: tokens [B, S] -> logits [B, S, V]."""
-    h = embed_apply(cfg, params["embed"], tokens)
-    h = body_apply(cfg, params["layers"], h)
+def transformer_apply(cfg: ModelConfig, params: Dict, tokens: jax.Array,
+                      rng: Optional[jax.Array] = None) -> jax.Array:
+    """Full-model forward: tokens [B, S] -> logits [B, S, V].
+
+    ``rng`` (train mode) enables dropout: layer i folds stream i, the
+    embedding folds stream ``n_layers`` — the same convention the pipeline
+    executor uses per microbatch, so executor masks are checkable against
+    this path."""
+    rng_e = None if rng is None else jax.random.fold_in(rng, cfg.n_layers)
+    h = embed_apply(cfg, params["embed"], tokens, rng=rng_e)
+    h = body_apply(cfg, params["layers"], h, rng=rng)
     return head_apply(cfg, params["head"], h)
 
 
 def transformer_loss(cfg: ModelConfig, params: Dict, tokens: jax.Array,
-                     targets: jax.Array) -> jax.Array:
+                     targets: jax.Array,
+                     rng: Optional[jax.Array] = None) -> jax.Array:
     """Single-device reference loss — the ground truth the pipeline executors
     are verified against (a check the reference itself never performs,
     SURVEY.md §4)."""
     return select_xent(cfg.use_fused_xent)(
-        transformer_apply(cfg, params, tokens), targets)
+        transformer_apply(cfg, params, tokens, rng=rng), targets)

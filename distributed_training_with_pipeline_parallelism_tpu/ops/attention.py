@@ -17,7 +17,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from .layers import linear_init, linear_apply
+from .layers import dropout_apply, linear_init, linear_apply
 
 
 def mha_init(key: jax.Array, dim: int, n_heads: int, n_kv_heads: Optional[int] = None,
@@ -96,19 +96,24 @@ def gqa_expand(k: jax.Array, v: jax.Array, n_heads: int):
 
 
 def scaled_dot_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                         mask: Optional[jax.Array] = None) -> jax.Array:
+                         mask: Optional[jax.Array] = None,
+                         dropout_rate: float = 0.0,
+                         dropout_rng=None) -> jax.Array:
     """Core attention: q [b,s,h,d] x k/v [b,t,h,d] -> [b,s,h,d].
 
     ``mask`` broadcasts against scores [b,h,s,t]; False positions are dropped.
     Shared by the training path (:func:`mha_apply`) and the KV-cache decode
     path (:mod:`..models.generate`) so the two cannot drift. Softmax runs in
-    f32 regardless of activation dtype.
+    f32 regardless of activation dtype. ``dropout_rng`` (train mode) applies
+    dropout to the attention probabilities, as torch's MultiheadAttention
+    does with a nonzero ``dropout`` constructor arg.
     """
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=q.dtype))
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    probs = dropout_apply(probs, dropout_rate, dropout_rng)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -136,7 +141,8 @@ def qkv_project(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
 def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
               causal: bool = False, rope_angles: Optional[jax.Array] = None,
               flash: bool = False, tp_axis: Optional[str] = None,
-              window: Optional[int] = None) -> jax.Array:
+              window: Optional[int] = None, dropout_rate: float = 0.0,
+              dropout_rng=None) -> jax.Array:
     """Attention: queries from ``q_in``, keys/values from ``kv_in`` (both [b, s, d]).
 
     ``flash=True`` routes the core attention through the fused Pallas kernel
@@ -152,6 +158,9 @@ def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
     q_in, kv_in = tp_attention_inputs(q_in, kv_in, tp_axis)
     q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles)
     if flash:
+        if dropout_rng is not None and dropout_rate > 0.0:
+            raise ValueError("flash attention does not support attention-prob "
+                             "dropout (guarded in ModelConfig)")
         from .pallas_attention import flash_attention
         out = flash_attention(q, k, v, causal=causal, window=window)
     else:
@@ -159,6 +168,6 @@ def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
         if causal:
             s = q_in.shape[1]
             mask = band_mask(s, s, window)[None, None]
-        out = scaled_dot_attention(q, k, v, mask)
+        out = scaled_dot_attention(q, k, v, mask, dropout_rate, dropout_rng)
     out = out.reshape(q_in.shape[0], q_in.shape[1], -1)
     return tp_output_projection(params["o"], out, tp_axis)

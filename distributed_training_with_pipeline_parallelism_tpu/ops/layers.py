@@ -61,6 +61,18 @@ def embedding_apply(table: jax.Array, tokens: jax.Array) -> jax.Array:
     return jnp.take(table, tokens, axis=0)
 
 
+def dropout_apply(x: jax.Array, rate: float, rng) -> jax.Array:
+    """Inverted dropout: zero each element with probability ``rate`` and scale
+    survivors by 1/(1-rate), matching ``torch.nn.functional.dropout`` train
+    semantics. ``rng=None`` (eval mode) or ``rate=0`` is the identity.
+    ``rate`` must be a static Python float (it selects the compiled program).
+    """
+    if rng is None or rate == 0.0:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros((), x.dtype))
+
+
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """Mean token-wise cross entropy over all positions.
 

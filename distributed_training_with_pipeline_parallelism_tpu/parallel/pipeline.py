@@ -128,6 +128,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     """Build an (unjitted) ``(params, tokens, targets) -> (loss, grads)``
     pipeline step — compose with an optimizer under one jit (see
     :mod:`..utils.train`) or jit directly via :func:`make_pipeline_step`.
+    With ``cfg.dropout > 0`` the step takes a fourth argument — a per-step
+    PRNG key — and runs train-mode dropout with masks that depend only on
+    (key, data shard, microbatch, global layer, site), i.e. independent of
+    the (D, V) stage partitioning (tests/test_dropout.py asserts this).
 
     ``params`` is the full-model pytree from ``transformer_init`` (or
     ``moe_lm_init`` when ``moe`` — a :class:`..models.moe.MoEConfig` — is
@@ -171,6 +175,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     ep_axis = EXPERT_AXIS if n_ep > 1 else None
     if n_ep > 1 and moe is None:
         raise ValueError("mesh has an 'expert' axis but no MoEConfig given")
+    use_dropout = cfg.dropout > 0.0
+    if use_dropout and (moe is not None or n_seq > 1 or T > 1):
+        raise NotImplementedError(
+            "dropout currently composes with dense data x pipe meshes; "
+            "model/seq/expert axes would need axis-aware mask folding")
     if moe is not None:
         if T > 1 or n_seq > 1:
             raise NotImplementedError(
@@ -183,7 +192,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             raise ValueError(f"n_experts={moe.n_experts} must divide over "
                              f"{n_ep} expert shards")
     if (D == 1 and n_data == 1 and T == 1 and n_seq == 1 and V == 1
-            and moe is None and not force_tick_executor):
+            and moe is None and not use_dropout and not force_tick_executor):
         # Degenerate 1-stage pipeline == a plain full-batch train step: the
         # microbatch-accumulated, 1/M-scaled loss/grads equal the full-batch
         # mean exactly (asserted in tests/test_pipeline.py), so skip the tick
@@ -204,13 +213,31 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     fwd_perm = [(i, (i + 1) % D) for i in range(D)]
     bwd_perm = [(i, (i - 1) % D) for i in range(D)]
 
-    def spmd_fn(layers_stacked, embed, head, tokens, targets):
+    lps = cfg.n_layers // (D * V)  # layers per stage (stack_stage_layers checks)
+
+    def spmd_fn(layers_stacked, embed, head, tokens, targets, rng_data=None):
         # Shapes inside shard_map: layers_stacked leaves [1, V, lps, ...];
-        # embed/head replicated; tokens/targets [B_local, S].
+        # embed/head replicated; tokens/targets [B_local, S]; rng_data (train
+        # mode, dropout > 0) is the step key's raw data, replicated.
         d = jax.lax.axis_index(PIPE_AXIS)
         layers_local = jax.tree.map(lambda x: x[0], layers_stacked)
         is_first_dev = d == 0
         is_last_dev = d == D - 1
+
+        if use_dropout:
+            base_rng = jax.random.wrap_key_data(rng_data)
+            if n_data > 1:  # decorrelate masks across data replicas
+                base_rng = jax.random.fold_in(
+                    base_rng, jax.lax.axis_index(DATA_AXIS))
+        else:
+            base_rng = None
+
+        def mb_rng(mm):
+            """Per-microbatch dropout stream. Masks depend only on (step key,
+            data shard, microbatch, global layer, site) — independent of the
+            (D, V) stage partitioning, and identical between the forward unit
+            and the rematerializing backward of the same microbatch."""
+            return None if base_rng is None else jax.random.fold_in(base_rng, mm)
 
         b_local, seq = tokens.shape
         assert b_local % M == 0, (
@@ -220,9 +247,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         targets_mb = targets.reshape(M, mb, seq)
         mb_shape = (mb, seq, cfg.dim)
 
-        def stage_body(layer_p, x):
+        def stage_body(layer_p, x, vv=0, mm=0):
             """-> (y, aux): aux is the stage's summed routing load-balance
-            loss (MoE stages), else a constant 0 that XLA eliminates."""
+            loss (MoE stages), else a constant 0 that XLA eliminates.
+            ``(vv, mm)`` select the dropout stream (train mode): the stack's
+            global layer offset is ``(vv*D + d) * lps``."""
             zero = jnp.zeros((), jnp.float32)
             if moe is not None:
                 from ..models.moe import moe_layer_apply
@@ -238,7 +267,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 return y, aux
             if sp_axis is None:
                 return (body_apply(cfg, layer_p, x, tp_axis=tp_axis,
-                                   tp_size=T), zero)
+                                   tp_size=T, rng=mb_rng(mm),
+                                   layer_offset=(vv * D + d) * lps), zero)
             # sequence-sharded stage: ring/Ulysses attention across 'seq'
             # (ring optionally Megatron head-sharded over 'model' as well)
             from .seq_parallel import sp_body_apply
@@ -246,9 +276,12 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                                   attn_impl=sp_attn_impl,
                                   tp_axis=tp_axis, tp_size=T), zero)
 
-        def stage_embed(embed_p, toks):
+        def stage_embed(embed_p, toks, mm=0):
             if sp_axis is None:
-                return embed_apply(cfg, embed_p, toks)
+                rng_mb = mb_rng(mm)
+                rng_e = (None if rng_mb is None
+                         else jax.random.fold_in(rng_mb, cfg.n_layers))
+                return embed_apply(cfg, embed_p, toks, rng=rng_e)
             from .seq_parallel import sp_embed_apply
             return sp_embed_apply(cfg, embed_p, toks, sp_axis)
 
@@ -270,13 +303,15 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         aux_scale = (moe.aux_loss_weight / cfg.n_layers / loss_norm
                      if moe is not None else 0.0)
 
-        def stage_objective(p_v, head_p, x_in, mm, last_stage, g_in):
+        def stage_objective(p_v, head_p, x_in, vv, mm, last_stage, g_in):
             """-> (objective, loss_report). The objective's gradients are the
             stage VJP: the real loss through the head on the last stage, else
             the contraction of the stage output with the incoming cotangent —
             plus this stage's share of the MoE routing aux loss. loss_report
-            is what the tick accumulates into the reported loss."""
-            y, aux = stage_body(p_v, x_in)
+            is what the tick accumulates into the reported loss. ``(vv, mm)``
+            select the dropout stream, so the rematerialized forward here
+            draws exactly the masks the forward unit drew."""
+            y, aux = stage_body(p_v, x_in, vv, mm)
 
             def loss_branch():
                 if tp_vocab_parallel:
@@ -330,10 +365,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 vv, mm = jnp.maximum(fv, 0), jnp.maximum(fm, 0)
                 ss = jnp.maximum(fslot, 0)
                 first_stage = is_first_dev & (vv == 0)
-                x_emb = stage_embed(embed, tokens_mb[mm]).astype(dtype)
+                x_emb = stage_embed(embed, tokens_mb[mm], mm).astype(dtype)
                 x = jnp.where(first_stage, x_emb, act_buf[ss])
                 act_buf = act_buf.at[ss].set(x)  # saved for remat backward
-                y, _ = stage_body(select_v(layers_local, vv), x)
+                y, _ = stage_body(select_v(layers_local, vv), x, vv, mm)
                 return act_buf, y
 
             def fwd_noop(act_buf):
@@ -358,8 +393,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     g_in = grad_buf[jnp.maximum(row[COL_BWD_GSLOT], 0)]
                     params_v = select_v(layers_local, vv)
                     (_, report), gx = jax.value_and_grad(
-                        lambda x_in: stage_objective(params_v, head, x_in, mm,
-                                                     last_stage, g_in),
+                        lambda x_in: stage_objective(params_v, head, x_in, vv,
+                                                     mm, last_stage, g_in),
                         has_aux=True)(x)
                     return loss_acc + report, gx
 
@@ -381,7 +416,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     params_v = select_v(layers_local, vv)
                     (gp, gh, gx), _ = jax.grad(
                         lambda p_v, head_p, x_in: stage_objective(
-                            p_v, head_p, x_in, mm, last_stage, g_in),
+                            p_v, head_p, x_in, vv, mm, last_stage, g_in),
                         argnums=(0, 1, 2), has_aux=True)(params_v, head, x_slot)
                     g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
                                             g_layers, gp)
@@ -393,7 +428,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                         lambda: jax.tree.map(
                             jnp.add, g_embed,
                             jax.grad(lambda e: jnp.vdot(
-                                stage_embed(e, tokens_mb[mm]).astype(jnp.float32),
+                                stage_embed(e, tokens_mb[mm], mm).astype(jnp.float32),
                                 gx.astype(jnp.float32)))(embed)),
                         lambda: g_embed)
                     return (g_layers, g_embed, g_head)
@@ -417,7 +452,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 params_v = select_v(layers_local, vv)
                 (_, report), (gp, gh, gx) = jax.value_and_grad(
                     lambda p_v, head_p, x_in: stage_objective(
-                        p_v, head_p, x_in, mm, last_stage, g_in),
+                        p_v, head_p, x_in, vv, mm, last_stage, g_in),
                     argnums=(0, 1, 2), has_aux=True)(params_v, head, x)
 
                 g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
@@ -428,7 +463,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     lambda: jax.tree.map(
                         jnp.add, g_embed,
                         jax.grad(lambda e: jnp.vdot(
-                            stage_embed(e, tokens_mb[mm]).astype(jnp.float32),
+                            stage_embed(e, tokens_mb[mm], mm).astype(jnp.float32),
                             gx.astype(jnp.float32)))(embed)),
                     lambda: g_embed)
                 loss_acc = loss_acc + report
@@ -542,22 +577,37 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         head_spec = {"norm": P(), "out": out_spec}
     else:
         head_spec = P()
+    in_specs = (layer_spec, P(), head_spec, batch_spec, batch_spec)
+    if use_dropout:
+        in_specs = in_specs + (P(),)  # step rng: replicated raw key data
     sharded = _shard_map(
         spmd_fn, mesh,
-        in_specs=(layer_spec, P(), head_spec, batch_spec, batch_spec),
+        in_specs=in_specs,
         out_specs=(P(), layer_spec, P(), head_spec),
     )
 
-    def step(params, tokens, targets):
-        stacked = stack_stage_layers(params["layers"], D, V)
-        loss, g_layers, g_embed, g_head = sharded(
-            stacked, params["embed"], params["head"], tokens, targets)
-        grads = {
+    def unpack(loss, g_layers, g_embed, g_head):
+        return loss, {
             "embed": g_embed,
             "layers": unstack_stage_layers(g_layers),
             "head": g_head,
         }
-        return loss, grads
+
+    if use_dropout:
+        # Train-mode step: the caller supplies a per-step PRNG key; passing
+        # the key's raw data through shard_map sidesteps typed-key sharding.
+        def step(params, tokens, targets, rng):
+            stacked = stack_stage_layers(params["layers"], D, V)
+            return unpack(*sharded(
+                stacked, params["embed"], params["head"], tokens, targets,
+                jax.random.key_data(rng)))
+
+        return step
+
+    def step(params, tokens, targets):
+        stacked = stack_stage_layers(params["layers"], D, V)
+        return unpack(*sharded(
+            stacked, params["embed"], params["head"], tokens, targets))
 
     return step
 
@@ -579,6 +629,94 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     return jax.jit(make_pipeline_grad_fn(
         cfg, mesh, sched, force_tick_executor=force_tick_executor, moe=moe,
         sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel))
+
+
+def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
+                          ) -> Callable[[Pytree, jax.Array, jax.Array],
+                                        jax.Array]:
+    """Jitted forward-only eval loss: ``(params, tokens, targets) -> loss``.
+
+    The evaluation twin of :func:`make_pipeline_grad_fn` — same fill-drain
+    microbatch forward as :func:`make_pipeline_forward`, but the last stage
+    computes the token-mean CE per microbatch (in eval mode: no dropout) and
+    accumulates it instead of materializing [B, S, V] logits. The mean over
+    microbatches equals the single-device full-batch ``transformer_loss``
+    exactly (asserted in tests/test_eval.py), at forward-only cost — no
+    backward, no rematerialization. Data x pipe meshes, 1 stage/device.
+    """
+    D = mesh.shape[PIPE_AXIS]
+    n_data = mesh.shape.get(DATA_AXIS, 1)
+    for axis in (MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS):
+        if mesh.shape.get(axis, 1) > 1:
+            raise NotImplementedError(
+                f"make_pipeline_loss_fn supports data x pipe meshes only "
+                f"(got a '{axis}' axis)")
+    M = sched.n_microbatches
+    if sched.n_virtual != 1:
+        raise NotImplementedError(
+            "make_pipeline_loss_fn runs 1 stage/device (fill-drain forward)")
+    if cfg.n_layers % D:
+        raise ValueError(f"n_layers={cfg.n_layers} must divide over {D} stages")
+    dtype = jnp.dtype(cfg.dtype)
+    fwd_perm = [(i, (i + 1) % D) for i in range(D)]
+    xent = select_xent(cfg.use_fused_xent)
+
+    def spmd_fn(layers_stacked, embed, head, tokens, targets):
+        d = jax.lax.axis_index(PIPE_AXIS)
+        layers_local = jax.tree.map(lambda x: x[0, 0], layers_stacked)
+        b_local, seq = tokens.shape
+        assert b_local % M == 0, (
+            f"local batch {b_local} not divisible by n_microbatches={M}")
+        mb = b_local // M
+        tokens_mb = tokens.reshape(M, mb, seq)
+        targets_mb = targets.reshape(M, mb, seq)
+
+        def tick(carry, t):
+            recv, loss_acc = carry
+            m = t - d  # fill-drain: device d runs microbatch t-d at tick t
+            active = (m >= 0) & (m < M)
+            mm = jnp.clip(m, 0, M - 1)
+
+            def active_fn():
+                x = jax.lax.cond(
+                    d == 0,
+                    lambda: embed_apply(cfg, embed, tokens_mb[mm]).astype(dtype),
+                    lambda: recv)
+                return body_apply(cfg, layers_local, x)
+
+            y = jax.lax.cond(
+                active, active_fn,
+                lambda: jnp.zeros((mb, seq, cfg.dim), dtype))
+            is_last = d == D - 1
+            loss_mb = jax.lax.cond(
+                active & is_last,
+                lambda: xent(head_apply(cfg, head, y), targets_mb[mm]),
+                lambda: jnp.zeros((), jnp.float32))
+            return (jax.lax.ppermute(y, PIPE_AXIS, fwd_perm),
+                    loss_acc + loss_mb), None
+
+        loss0 = jnp.zeros((), jnp.float32)
+        recv0 = jnp.zeros((mb, seq, cfg.dim), dtype)
+        (_, loss), _ = jax.lax.scan(tick, (recv0, loss0),
+                                    jnp.arange(M + D - 1))
+        loss = jax.lax.psum(loss, PIPE_AXIS) / M  # lives on the last device
+        if n_data > 1:
+            loss = jax.lax.psum(loss / n_data, DATA_AXIS)
+        return loss
+
+    sharded = _shard_map(
+        spmd_fn, mesh,
+        in_specs=(P(PIPE_AXIS), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    )
+
+    @jax.jit
+    def loss_fn(params, tokens, targets):
+        stacked = stack_stage_layers(params["layers"], D, 1)
+        return sharded(stacked, params["embed"], params["head"],
+                       tokens, targets)
+
+    return loss_fn
 
 
 def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,

@@ -38,9 +38,11 @@ class ModelConfig:
     ffn_dim: int = 2048
     max_seq_len: int = 2048
     arch: str = "ref_decoder"
-    dropout: float = 0.0  # reference implicitly trains with torch's default 0.1;
-    # we default to 0.0 for determinism (loss values are never asserted by the
-    # reference — only throughput — so this does not affect parity).
+    dropout: float = 0.0  # train-mode dropout rate. The reference implicitly
+    # trains with torch's default 0.1 (nn.TransformerDecoderLayer); we default
+    # to 0.0 for determinism (it never asserts loss values — only throughput).
+    # Active only when an rng is passed to the apply/loss/pipeline functions
+    # (train mode); calls without an rng always run deterministically.
     dtype: str = "float32"
     use_flash_attention: bool = False  # route attention through the Pallas kernel
     use_fused_xent: bool = False  # route the loss through the Pallas fused-CE kernel
@@ -70,10 +72,14 @@ class ModelConfig:
             if self.sliding_window < 1:
                 raise ValueError(f"sliding_window={self.sliding_window} must "
                                  f"be >= 1")
-        if self.dropout != 0.0:
-            raise ValueError("dropout is not implemented yet; the reference implicitly "
-                             "trains with torch's default 0.1 but never asserts loss "
-                             "values, so 0.0 preserves behavioral parity")
+        if not 0.0 <= self.dropout < 1.0:
+            raise ValueError(f"dropout={self.dropout} must be in [0, 1)")
+        if self.dropout > 0.0 and self.use_flash_attention:
+            raise ValueError(
+                "dropout composes with the dense XLA attention path only: "
+                "the Pallas flash kernel does not implement attention-prob "
+                "dropout (torch applies dropout to attention weights, so "
+                "silently skipping it would change train-mode semantics)")
 
     @property
     def causal(self) -> bool:

@@ -9,7 +9,9 @@ the same pipeline gradients with an optax optimizer under a single jit here.
 
 from __future__ import annotations
 
+import dataclasses
 import json
+import math
 import os
 import time
 from typing import Any, Callable, Iterator, Optional, Tuple
@@ -40,6 +42,17 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     grad_fn = make_pipeline_grad_fn(cfg, mesh, sched, moe=moe,
                                     sp_attn_impl=sp_attn_impl,
                                     tp_vocab_parallel=tp_vocab_parallel)
+
+    if cfg.dropout > 0.0:
+        # train-mode dropout: the step takes a per-step PRNG key
+        @jax.jit
+        def train_step_dropout(params, opt_state, tokens, targets, rng):
+            loss, grads = grad_fn(params, tokens, targets, rng)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return train_step_dropout
 
     @jax.jit
     def train_step(params, opt_state, tokens, targets):
@@ -118,6 +131,62 @@ def adamw(learning_rate: float = 3e-4, weight_decay: float = 0.01,
     )
 
 
+def make_eval_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
+                 moe=None, sp_attn_impl: str = "ring",
+                 tp_vocab_parallel: bool = False,
+                 ) -> Callable[[Pytree, jax.Array, jax.Array], jax.Array]:
+    """Jitted eval-mode loss over the mesh. Dense data x pipe meshes use the
+    forward-only pipelined loss (no backward cost); any other configuration
+    falls back to the training grad function — built with the SAME
+    parallelization knobs as the train step — with the gradients discarded
+    (still eval-mode: no rng is threaded, so dropout is off)."""
+    from ..parallel.mesh import DATA_AXIS as _DA, PIPE_AXIS as _PA
+    from ..parallel.pipeline import make_pipeline_loss_fn
+
+    dense_dp_pp = (moe is None and sched.n_virtual == 1 and all(
+        mesh.shape.get(ax, 1) == 1 or ax in (_DA, _PA)
+        for ax in mesh.shape))
+    if dense_dp_pp and cfg.n_layers % mesh.shape[_PA] == 0:
+        eval_cfg = (dataclasses.replace(cfg, dropout=0.0)
+                    if cfg.dropout else cfg)
+        return make_pipeline_loss_fn(eval_cfg, mesh, sched)
+    grad_fn = make_pipeline_grad_fn(
+        dataclasses.replace(cfg, dropout=0.0), mesh, sched, moe=moe,
+        sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel)
+
+    @jax.jit
+    def loss_only(params, tokens, targets):
+        loss, _ = grad_fn(params, tokens, targets)
+        return loss
+
+    return loss_only
+
+
+def evaluate(eval_fn, params, data: Iterator[Tuple[jax.Array, jax.Array]],
+             num_batches: int) -> dict:
+    """Mean eval loss and perplexity over ``num_batches`` from ``data``.
+
+    The reference has no evaluation path at all (SURVEY.md §5: loss values
+    are never asserted, data is random tokens); this is the standard LM eval
+    the model ladder needs. Returns ``{"eval_loss", "perplexity",
+    "num_batches"}``; perplexity = exp(mean token CE).
+    """
+    total = 0.0
+    n = 0
+    for _ in range(num_batches):
+        try:
+            tokens, targets = next(data)
+        except StopIteration:
+            break
+        total += float(eval_fn(params, tokens, targets))
+        n += 1
+    if n == 0:
+        raise ValueError("evaluate: data iterator yielded no batches")
+    mean = total / n
+    return {"eval_loss": mean, "perplexity": math.exp(min(mean, 700.0)),
+            "num_batches": n}
+
+
 def _latest_step_dir(checkpoint_dir: str) -> Optional[Tuple[int, str]]:
     """Find the newest ``step_{n}`` checkpoint under ``checkpoint_dir``."""
     if not os.path.isdir(checkpoint_dir):
@@ -142,7 +211,9 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         resume: bool = False, skip_data_on_resume: bool = True,
         metrics_path: Optional[str] = None, moe=None,
         sp_attn_impl: str = "ring", tp_vocab_parallel: bool = False,
-        zero1: bool = False):
+        zero1: bool = False, dropout_seed: int = 0,
+        eval_data: Optional[Callable[[], Iterator]] = None,
+        eval_every: int = 0, eval_batches: int = 8):
     """Training loop over a ``(tokens, targets)`` iterator.
 
     Returns (params, list of (step, loss)). The data contract matches the
@@ -163,6 +234,12 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
       ``{"step", "loss", "tokens_per_sec", "elapsed_s"}`` — the streaming
       twin of the sweep's metrics dict (same tokens/sec definition:
       batch*seq*steps / wall-clock between log points).
+    - ``eval_data`` + ``eval_every``: every n steps (and at the end), run
+      :func:`evaluate` over ``eval_batches`` batches from a FRESH iterator
+      (``eval_data`` is a zero-arg callable returning one, so the same
+      held-out batches are scored every time); results go to the metrics
+      stream and (``verbose``) stdout. Eval runs in eval mode
+      (no dropout) on the forward-only pipelined loss where the mesh allows.
     """
     optimizer = optimizer or adamw(total_steps=num_steps)
     step_fn = make_train_step(cfg, mesh, sched, optimizer, moe=moe,
@@ -199,12 +276,37 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                         {"params": params, "opt_state": opt_state,
                          "step": jnp.asarray(i)})
 
+    # Per-step dropout keys fold the step index from one base key, so a
+    # resumed run draws the same masks the uninterrupted run would have.
+    drop_key = jax.random.key(dropout_seed) if cfg.dropout > 0.0 else None
+
+    eval_fn = None
+    if eval_data is not None and eval_every:
+        eval_fn = make_eval_fn(cfg, mesh, sched, moe=moe,
+                               sp_attn_impl=sp_attn_impl,
+                               tp_vocab_parallel=tp_vocab_parallel)
+
+    def _eval(i):
+        m = evaluate(eval_fn, params, eval_data(), eval_batches)
+        if verbose:
+            print(f"step {i}: eval_loss {m['eval_loss']:.4f} "
+                  f"ppl {m['perplexity']:.2f}", flush=True)
+        if metrics_path:
+            with open(metrics_path, "a") as f:
+                f.write(json.dumps({"step": i, **m}) + "\n")
+        return m
+
     history = []
     window_start = time.perf_counter()
     window_tokens = 0
     for i in range(start_step, num_steps):
         tokens, targets = next(data)
-        params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
+        if drop_key is not None:
+            params, opt_state, loss = step_fn(
+                params, opt_state, tokens, targets,
+                jax.random.fold_in(drop_key, i))
+        else:
+            params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
         window_tokens += tokens.shape[0] * tokens.shape[1]
         if i % log_every == 0 or i == num_steps - 1:
             loss_f = float(loss)  # device sync: closes the timing window
@@ -220,9 +322,18 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                         "elapsed_s": round(elapsed, 4)}) + "\n")
             window_start = time.perf_counter()
             window_tokens = 0
+        if (eval_fn is not None and (i + 1) % eval_every == 0
+                and i != num_steps - 1):
+            _eval(i)
+            # eval time isn't train time: restart the whole timing window
+            # (tokens too, else the next tokens_per_sec over-reports)
+            window_start = time.perf_counter()
+            window_tokens = 0
         if (checkpoint_dir and checkpoint_every
                 and (i + 1) % checkpoint_every == 0 and i != num_steps - 1):
             _save(i)
+    if eval_fn is not None and num_steps > start_step:
+        _eval(num_steps - 1)
     if checkpoint_dir and checkpoint_every and num_steps > start_step:
         _save(num_steps - 1)
     return params, history

@@ -50,6 +50,10 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--dropout", type=float, default=0.0,
+                    help="train-mode dropout rate (torch's "
+                         "TransformerDecoderLayer default is 0.1); masks are "
+                         "seeded from --seed and independent of the mesh")
     ap.add_argument("--dtype", default="float32")
     ap.add_argument("--flash", action="store_true",
                     help="Pallas fused flash attention")
@@ -79,6 +83,12 @@ def main():
     ap.add_argument("--data-file", default="",
                     help="flat binary token file (uint16 ids); default is "
                          "the reference's synthetic random-token regime")
+    ap.add_argument("--eval-file", default="",
+                    help="held-out token file; with --eval-every, score "
+                         "eval loss + perplexity on it during training")
+    ap.add_argument("--eval-every", type=int, default=0,
+                    help="evaluate every N steps (and at the end)")
+    ap.add_argument("--eval-batches", type=int, default=8)
     ap.add_argument("--native-loader", action="store_true",
                     help="read --data-file through the C++ prefetching "
                          "loader (csrc/data_loader.cpp)")
@@ -145,6 +155,8 @@ def main():
         n_heads=args.heads, vocab_size=args.vocab,
     ).items() if v}
     overrides["dtype"] = args.dtype
+    if args.dropout:
+        overrides["dropout"] = args.dropout
     if args.flash:
         overrides["use_flash_attention"] = True
     if args.fused_xent:
@@ -220,6 +232,16 @@ def main():
     if args.prefetch > 0:
         data = prefetch_to_device(data, depth=args.prefetch,
                                   sharding=batch_sharding(mesh))
+
+    eval_data = None
+    if args.eval_every:
+        if args.eval_file:
+            eval_data = lambda: TokenFileDataset(  # noqa: E731
+                args.eval_file, args.seq, seed=123).batches(args.batch)
+        else:
+            eval_data = lambda: train.synthetic_data(  # noqa: E731
+                cfg, args.batch, args.seq, seed=123)
+
     params, history = train.fit(
         cfg, mesh, sched, params, data, args.steps, optimizer=optimizer,
         log_every=max(1, args.steps // 20),
@@ -227,7 +249,9 @@ def main():
         checkpoint_every=(args.ckpt_every or args.steps) if args.ckpt else 0,
         resume=args.auto_resume, metrics_path=args.metrics or None, moe=moe,
         sp_attn_impl=args.sp_attn, tp_vocab_parallel=args.vocab_parallel,
-        zero1=args.zero1)
+        zero1=args.zero1, dropout_seed=args.seed,
+        eval_data=eval_data, eval_every=args.eval_every,
+        eval_batches=args.eval_batches)
     if args.ckpt:
         print(f"checkpoints in {args.ckpt}", flush=True)
     if history:

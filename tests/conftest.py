@@ -8,7 +8,6 @@ environment force-selects the axon TPU plugin via JAX_PLATFORMS, so we also
 override through jax.config (env alone is not enough here).
 """
 
-import getpass
 import os
 import tempfile
 
@@ -25,8 +24,10 @@ jax.config.update("jax_platforms", "cpu")
 # tiny jitted programs); re-runs hit the cache and finish in a fraction of
 # the cold time. Keyed by HLO hash, so code changes invalidate safely.
 # User-scoped path: a world-shared fixed dir breaks on multi-user machines
-# (first user owns it; everyone else's writes fail silently).
+# (first user owns it; everyone else's writes fail silently). getuid, not
+# getpass: containers with arbitrary UIDs may have no passwd/env user at all.
+_uid = os.getuid() if hasattr(os, "getuid") else "na"
 jax.config.update(
     "jax_compilation_cache_dir",
-    os.path.join(tempfile.gettempdir(), f"dtpp_jax_cache_{getpass.getuser()}"))
+    os.path.join(tempfile.gettempdir(), f"dtpp_jax_cache_{_uid}"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

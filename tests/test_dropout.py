@@ -1,0 +1,152 @@
+"""Train-mode dropout: torch-site semantics, determinism, and
+partition-invariance through the pipeline executor.
+
+The reference implicitly trains with dropout 0.1 (torch's
+``nn.TransformerDecoderLayer`` default — ``LLMsDistributedTrainingHelper.py:37``
+never overrides it); it never asserts loss values, so the capability to test
+here is our own contract: masks are a pure function of
+(step key, data shard, microbatch, global layer, site), which makes a
+pipeline run's loss/grads independent of how stages are partitioned and
+makes the rematerializing backward consistent with its forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.models import transformer as tfm
+from distributed_training_with_pipeline_parallelism_tpu.ops.layers import dropout_apply
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import make_mesh
+from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+    make_pipeline_step)
+
+CFG = dtpp.ModelConfig(dim=32, n_layers=8, n_heads=4, vocab_size=50,
+                       ffn_dim=64, dropout=0.2)
+CFG_EVAL = dtpp.ModelConfig(dim=32, n_layers=8, n_heads=4, vocab_size=50,
+                            ffn_dim=64)
+
+
+def test_dropout_apply_identity_and_scaling():
+    x = jax.random.normal(jax.random.key(0), (64, 64))
+    assert dropout_apply(x, 0.0, jax.random.key(1)) is x
+    assert dropout_apply(x, 0.5, None) is x
+    y = dropout_apply(x, 0.5, jax.random.key(1))
+    zeros = float(jnp.mean(y == 0.0))
+    assert 0.4 < zeros < 0.6  # ~half dropped
+    # survivors are scaled by 1/(1-p)
+    kept = jnp.abs(y) > 0
+    assert jnp.allclose(jnp.where(kept, y, 0.0), jnp.where(kept, 2.0 * x, 0.0))
+
+
+def test_dropout_rate_validation():
+    with pytest.raises(ValueError):
+        dtpp.ModelConfig(dropout=1.0)
+    with pytest.raises(ValueError):
+        dtpp.ModelConfig(dropout=-0.1)
+    with pytest.raises(ValueError):
+        dtpp.ModelConfig(dropout=0.1, use_flash_attention=True)
+
+
+@pytest.mark.parametrize("arch", ["ref_decoder", "gpt2", "llama"])
+def test_train_vs_eval_and_determinism(arch):
+    cfg = dtpp.ModelConfig(dim=32, n_layers=2, n_heads=4, vocab_size=50,
+                           ffn_dim=64, dropout=0.3, arch=arch,
+                           max_seq_len=16)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 8), 0, cfg.vocab_size)
+    eval_loss = tfm.transformer_loss(cfg, params, tokens, tokens)
+    l1 = tfm.transformer_loss(cfg, params, tokens, tokens, rng=jax.random.key(7))
+    l1b = tfm.transformer_loss(cfg, params, tokens, tokens, rng=jax.random.key(7))
+    l2 = tfm.transformer_loss(cfg, params, tokens, tokens, rng=jax.random.key(8))
+    assert float(l1) == float(l1b)  # same key -> same masks
+    assert float(l1) != float(l2)  # different key -> different masks
+    assert float(l1) != float(eval_loss)  # train mode != eval mode
+    assert jnp.isfinite(l1)
+
+
+def test_eval_path_unchanged_by_dropout_config():
+    # with no rng, a dropout>0 config computes exactly the dropout=0 loss
+    params = tfm.transformer_init(jax.random.key(0), CFG_EVAL)
+    tokens = jax.random.randint(jax.random.key(1), (4, 8), 0, 50)
+    l_cfg = tfm.transformer_loss(CFG, params, tokens, tokens)
+    l_eval = tfm.transformer_loss(CFG_EVAL, params, tokens, tokens)
+    assert float(l_cfg) == float(l_eval)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    params = tfm.transformer_init(jax.random.key(0), CFG)
+    tokens = jax.random.randint(jax.random.key(1), (8, 6), 0, CFG.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (8, 6), 0, CFG.vocab_size)
+    return params, tokens, targets
+
+
+def test_pipeline_matches_manual_microbatched_reference(problem):
+    """The executor's dropout masks per microbatch equal the single-device
+    path's with rng = fold_in(step_key, m) — so a D=2 pipeline run equals
+    the manual microbatched average exactly."""
+    params, tokens, targets = problem
+    M = 4
+    rng = jax.random.key(11)
+    step = make_pipeline_step(
+        CFG, make_mesh(n_pipe=2),
+        dtpp.ScheduleConfig(name="GPipe", n_microbatches=M))
+    loss, grads = step(params, tokens, targets, rng)
+
+    tokens_mb = tokens.reshape(M, -1, tokens.shape[1])
+    targets_mb = targets.reshape(M, -1, targets.shape[1])
+
+    def manual(p):
+        losses = [
+            tfm.transformer_loss(CFG, p, tokens_mb[m], targets_mb[m],
+                                 rng=jax.random.fold_in(rng, m))
+            for m in range(M)
+        ]
+        return sum(losses) / M
+
+    ref_loss, ref_grads = jax.value_and_grad(manual)(params)
+    assert float(jnp.abs(loss - ref_loss)) < 1e-5
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                       grads, ref_grads)
+    assert max(jax.tree.leaves(err)) < 1e-5
+
+
+@pytest.mark.parametrize("name,D,V,M", [
+    ("1F1B", 4, 1, 4),
+    ("Interleaved1F1B", 2, 2, 4),
+    ("BFS", 2, 2, 4),
+])
+def test_pipeline_dropout_partition_invariance(problem, name, D, V, M):
+    """Same step key, different stage partitions -> identical loss and grads:
+    masks key off the *global* layer index, not the (device, virtual) slot."""
+    params, tokens, targets = problem
+    rng = jax.random.key(3)
+    base = make_pipeline_step(
+        CFG, make_mesh(n_pipe=2),
+        dtpp.ScheduleConfig(name="GPipe", n_microbatches=M))
+    loss0, grads0 = jax.device_get(base(params, tokens, targets, rng))
+    step = make_pipeline_step(
+        CFG, make_mesh(n_pipe=D),
+        dtpp.ScheduleConfig(name=name, n_microbatches=M, n_virtual=V))
+    loss, grads = jax.device_get(step(params, tokens, targets, rng))
+    # device_get: the two runs live on different meshes (2 vs D devices)
+    assert abs(loss - loss0) < 1e-5
+    import numpy as np
+    err = jax.tree.map(lambda a, b: float(np.max(np.abs(a - b))),
+                       grads, grads0)
+    assert max(jax.tree.leaves(err)) < 1e-5
+
+
+def test_train_step_with_dropout_smoke():
+    from distributed_training_with_pipeline_parallelism_tpu.utils.train import (
+        fit, synthetic_data)
+
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=50,
+                           ffn_dim=64, dropout=0.1)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    data = synthetic_data(cfg, batch_size=8, seq_length=8)
+    params, history = fit(cfg, make_mesh(n_pipe=2),
+                          dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
+                          params, data, num_steps=3, verbose=False)
+    assert all(jnp.isfinite(loss) for _, loss in history)

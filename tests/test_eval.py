@@ -1,0 +1,98 @@
+"""Evaluation path: forward-only pipelined loss, evaluate(), eval-in-fit.
+
+The reference has no evaluation of any kind (SURVEY.md §5: random-token data,
+loss never asserted). The contracts tested here are ours: the forward-only
+pipelined eval loss equals the single-device ``transformer_loss`` exactly,
+and it stays in eval mode (no dropout) even when the config trains with
+dropout.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.models import transformer as tfm
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import make_mesh
+from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+    make_pipeline_loss_fn)
+from distributed_training_with_pipeline_parallelism_tpu.utils.train import (
+    evaluate, make_eval_fn)
+
+CFG = dtpp.ModelConfig(dim=32, n_layers=8, n_heads=4, vocab_size=50, ffn_dim=64)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    params = tfm.transformer_init(jax.random.key(0), CFG)
+    tokens = jax.random.randint(jax.random.key(1), (8, 6), 0, CFG.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (8, 6), 0, CFG.vocab_size)
+    ref = float(tfm.transformer_loss(CFG, params, tokens, targets))
+    return params, tokens, targets, ref
+
+
+@pytest.mark.parametrize("D,n_data,M", [(2, 1, 4), (4, 1, 2), (2, 2, 2), (1, 1, 4)])
+def test_pipeline_loss_matches_single_device(problem, D, n_data, M):
+    params, tokens, targets, ref = problem
+    loss_fn = make_pipeline_loss_fn(
+        CFG, make_mesh(n_pipe=D, n_data=n_data),
+        dtpp.ScheduleConfig(name="GPipe", n_microbatches=M))
+    loss = float(loss_fn(params, tokens, targets))
+    assert abs(loss - ref) < 1e-5
+
+
+def test_eval_fn_ignores_dropout(problem):
+    # a dropout>0 training config must still evaluate in eval mode
+    params, tokens, targets, ref = problem
+    import dataclasses
+    cfg_do = dataclasses.replace(CFG, dropout=0.3)
+    eval_fn = make_eval_fn(cfg_do, make_mesh(n_pipe=2),
+                           dtpp.ScheduleConfig(name="GPipe", n_microbatches=2))
+    assert abs(float(eval_fn(params, tokens, targets)) - ref) < 1e-5
+
+
+def test_eval_fn_fallback_meshes(problem):
+    # virtual stages force the grad-fn fallback; loss must still match
+    params, tokens, targets, ref = problem
+    eval_fn = make_eval_fn(
+        CFG, make_mesh(n_pipe=2),
+        dtpp.ScheduleConfig(name="Interleaved1F1B", n_microbatches=4,
+                            n_virtual=2))
+    assert abs(float(eval_fn(params, tokens, targets)) - ref) < 1e-5
+
+
+def test_evaluate_aggregates(problem):
+    params, tokens, targets, _ = problem
+    eval_fn = make_eval_fn(CFG, make_mesh(n_pipe=2),
+                           dtpp.ScheduleConfig(name="GPipe", n_microbatches=2))
+
+    def batches():
+        for k in range(3):
+            yield tokens, targets
+
+    m = evaluate(eval_fn, params, batches(), num_batches=5)
+    assert m["num_batches"] == 3  # iterator exhausted early is fine
+    assert m["perplexity"] == pytest.approx(
+        float(jnp.exp(jnp.asarray(m["eval_loss"]))), rel=1e-6)
+
+
+def test_fit_with_eval(tmp_path):
+    from distributed_training_with_pipeline_parallelism_tpu.utils.train import (
+        fit, synthetic_data)
+
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=50,
+                           ffn_dim=64)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    metrics = tmp_path / "metrics.jsonl"
+    params, _ = fit(
+        cfg, make_mesh(n_pipe=2),
+        dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
+        params, synthetic_data(cfg, 8, 8), num_steps=4, verbose=False,
+        metrics_path=str(metrics),
+        eval_data=lambda: synthetic_data(cfg, 8, 8, seed=99),
+        eval_every=2, eval_batches=2)
+    import json
+    lines = [json.loads(l) for l in metrics.read_text().splitlines()]
+    evals = [l for l in lines if "eval_loss" in l]
+    assert len(evals) >= 2  # mid-run + final
+    assert all(jnp.isfinite(e["eval_loss"]) for e in evals)
