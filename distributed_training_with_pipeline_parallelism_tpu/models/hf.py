@@ -216,3 +216,145 @@ def from_hf(model, dtype: str = "float32") -> Tuple[ModelConfig, Pytree]:
     config_fn, params_fn = _CONVERTERS[mt]
     cfg = dataclasses.replace(config_fn(model.config), dtype=dtype)
     return cfg, params_fn(model, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Export (the inverse direction): this framework -> transformers
+# ---------------------------------------------------------------------------
+
+
+def _f32(x) -> np.ndarray:
+    return np.asarray(x, dtype=np.float32)
+
+
+def gpt2_state_dict(cfg: ModelConfig, params: Pytree) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`gpt2_params_from_hf`: stacked-layer pytree ->
+    ``GPT2LMHeadModel`` state-dict arrays (Conv1D [in, out] layout; q/k/v
+    packed back into ``c_attn``)."""
+    L = cfg.n_layers
+    lv = lambda leaf, i: _f32(leaf[i])  # noqa: E731 - stacked leaf -> layer i
+    sd: Dict[str, np.ndarray] = {
+        "transformer.wte.weight": _f32(params["embed"]["tok"]),
+        "transformer.wpe.weight": _f32(params["embed"]["pos"]),
+        "transformer.ln_f.weight": _f32(params["head"]["norm"]["scale"]),
+        "transformer.ln_f.bias": _f32(params["head"]["norm"]["bias"]),
+        "lm_head.weight": _f32(params["head"]["out"]["w"]).T,
+    }
+    ly = params["layers"]
+    for i in range(L):
+        p = f"transformer.h.{i}."
+        a = ly["attn"]
+        sd[p + "ln_1.weight"] = lv(ly["ln1"]["scale"], i)
+        sd[p + "ln_1.bias"] = lv(ly["ln1"]["bias"], i)
+        sd[p + "attn.c_attn.weight"] = np.concatenate(
+            [lv(a["q"]["w"], i), lv(a["k"]["w"], i), lv(a["v"]["w"], i)], axis=1)
+        sd[p + "attn.c_attn.bias"] = np.concatenate(
+            [lv(a["q"]["b"], i), lv(a["k"]["b"], i), lv(a["v"]["b"], i)])
+        sd[p + "attn.c_proj.weight"] = lv(a["o"]["w"], i)
+        sd[p + "attn.c_proj.bias"] = lv(a["o"]["b"], i)
+        sd[p + "ln_2.weight"] = lv(ly["ln2"]["scale"], i)
+        sd[p + "ln_2.bias"] = lv(ly["ln2"]["bias"], i)
+        sd[p + "mlp.c_fc.weight"] = lv(ly["lin1"]["w"], i)
+        sd[p + "mlp.c_fc.bias"] = lv(ly["lin1"]["b"], i)
+        sd[p + "mlp.c_proj.weight"] = lv(ly["lin2"]["w"], i)
+        sd[p + "mlp.c_proj.bias"] = lv(ly["lin2"]["b"], i)
+    return sd
+
+
+def llama_state_dict(cfg: ModelConfig, params: Pytree) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`llama_params_from_hf` ([in, out] -> torch [out, in])."""
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _f32(params["embed"]["tok"]),
+        "model.norm.weight": _f32(params["head"]["norm"]["scale"]),
+        "lm_head.weight": _f32(params["head"]["out"]["w"]).T,
+    }
+    ly = params["layers"]
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        a = ly["attn"]
+        sd[p + "input_layernorm.weight"] = _f32(ly["rms1"]["scale"][i])
+        sd[p + "self_attn.q_proj.weight"] = _f32(a["q"]["w"][i]).T
+        sd[p + "self_attn.k_proj.weight"] = _f32(a["k"]["w"][i]).T
+        sd[p + "self_attn.v_proj.weight"] = _f32(a["v"]["w"][i]).T
+        sd[p + "self_attn.o_proj.weight"] = _f32(a["o"]["w"][i]).T
+        sd[p + "post_attention_layernorm.weight"] = _f32(ly["rms2"]["scale"][i])
+        sd[p + "mlp.gate_proj.weight"] = _f32(ly["w1"]["w"][i]).T
+        sd[p + "mlp.down_proj.weight"] = _f32(ly["w2"]["w"][i]).T
+        sd[p + "mlp.up_proj.weight"] = _f32(ly["w3"]["w"][i]).T
+    return sd
+
+
+def to_hf(cfg: ModelConfig, params: Pytree):
+    """Convert (ModelConfig, params) to a ``transformers`` model —
+    ``GPT2LMHeadModel`` or ``LlamaForCausalLM``/``MistralForCausalLM``
+    (Mistral when ``cfg.sliding_window`` is set). The round trip
+    ``from_hf(to_hf(cfg, params))`` is exact, and exported logits match this
+    framework's (tests/test_hf_export.py). Save with
+    ``to_hf(...).save_pretrained(path)``.
+
+    ``tie_word_embeddings=False`` always: this framework trains the output
+    head independently of the token embedding (SURVEY.md C2: the reference's
+    ``Linear(dim, vocab)`` is untied), so a tied HF model could not represent
+    a trained checkpoint.
+
+    The reference has no export path at all (SURVEY.md §5 checkpoint row);
+    this closes the loop with :func:`from_hf` so models pretrained or
+    fine-tuned here flow back into the HF ecosystem.
+    """
+    import torch
+    import transformers
+
+    if cfg.arch == "gpt2":
+        hf_cfg = transformers.GPT2Config(
+            vocab_size=cfg.vocab_size, n_positions=cfg.max_seq_len,
+            n_embd=cfg.dim, n_layer=cfg.n_layers, n_head=cfg.n_heads,
+            n_inner=cfg.ffn_dim, tie_word_embeddings=False)
+        model = transformers.GPT2LMHeadModel(hf_cfg)
+        sd = gpt2_state_dict(cfg, params)
+    elif cfg.arch == "llama":
+        common = dict(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.dim,
+            intermediate_size=cfg.ffn_dim, num_hidden_layers=cfg.n_layers,
+            num_attention_heads=cfg.n_heads,
+            num_key_value_heads=cfg.n_kv_heads or cfg.n_heads,
+            max_position_embeddings=cfg.max_seq_len,
+            rms_norm_eps=cfg.rms_eps, rope_theta=cfg.rope_theta,
+            tie_word_embeddings=False)
+        if cfg.sliding_window is not None:
+            if cfg.rope_scaling is not None:
+                raise NotImplementedError(
+                    "sliding_window + rope_scaling: MistralConfig carries no "
+                    "llama3 rope_scaling field")
+            hf_cfg = transformers.MistralConfig(
+                sliding_window=cfg.sliding_window, **common)
+            model = transformers.MistralForCausalLM(hf_cfg)
+        else:
+            if cfg.rope_scaling is not None:
+                factor, low, high, orig = cfg.rope_scaling
+                common["rope_scaling"] = {
+                    "rope_type": "llama3", "factor": factor,
+                    "low_freq_factor": low, "high_freq_factor": high,
+                    "original_max_position_embeddings": orig}
+            hf_cfg = transformers.LlamaConfig(
+                attention_bias=False, mlp_bias=False, **common)
+            model = transformers.LlamaForCausalLM(hf_cfg)
+        sd = llama_state_dict(cfg, params)
+    else:
+        raise ValueError(
+            f"arch {cfg.arch!r} has no HF equivalent (the ref_decoder block "
+            f"is the reference-parity architecture, not a public one)")
+
+    with torch.no_grad():
+        # copy: from_numpy on a non-writable jax-exported array warns, and
+        # the state dict should own its memory anyway
+        missing, unexpected = model.load_state_dict(
+            {k: torch.from_numpy(np.array(v)) for k, v in sd.items()},
+            strict=False)
+    unexpected = [k for k in unexpected]
+    # rotary inv_freq buffers etc. may be "missing" (they are derived);
+    # a real weight missing or an unknown key is a conversion bug
+    real_missing = [k for k in missing if "inv_freq" not in k]
+    if real_missing or unexpected:
+        raise RuntimeError(f"export mismatch: missing={real_missing}, "
+                           f"unexpected={unexpected}")
+    return model.eval()

@@ -36,14 +36,63 @@ def restore_checkpoint(path: str, template: Optional[Pytree] = None) -> Pytree:
     if template is not None:
         from jax.sharding import NamedSharding
 
+        import orbax.checkpoint as ocp
+
         def as_struct(x):
             # carry mesh-aware shardings (e.g. ZeRO-1 moments) so restore
-            # materializes directly into the sharded layout; plain
-            # single-device placements restore uncommitted, as before
+            # materializes directly into the sharded layout; everything else
+            # passes None, letting orbax restore per the checkpoint's own
+            # metadata (in-process this reproduces the saved placement, so
+            # jit inputs stay compatible with the mesh they were saved
+            # under). ocp.PLACEHOLDER leaves pass through: orbax skips them
+            # (partial restore — e.g. the export CLI leaving the optimizer
+            # moments on disk).
+            if x is ocp.PLACEHOLDER:
+                return x
             sh = getattr(x, "sharding", None)
             sh = sh if isinstance(sh, NamedSharding) else None
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
 
-        return ckpt.restore(os.path.abspath(path), jax.tree.map(as_struct,
-                                                                template))
+        structs = jax.tree.map(as_struct, template)
+        leaves = jax.tree.leaves(structs)
+        partial = any(l is ocp.PLACEHOLDER for l in leaves)
+        had_none = any(getattr(s, "sharding", 1) is None for s in leaves)
+
+        def _restore(tree):
+            if partial:
+                # partial restore: StandardCheckpointHandler rejects
+                # PLACEHOLDER; the PyTree handler skips those subtrees
+                # entirely (never read from disk). It ignores the item
+                # structs' shardings, so they travel via restore_args.
+                rargs = jax.tree.map(
+                    lambda s: ocp.RestoreArgs() if s is ocp.PLACEHOLDER
+                    else ocp.ArrayRestoreArgs(sharding=s.sharding,
+                                              global_shape=s.shape,
+                                              dtype=s.dtype),
+                    tree)
+                with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as c:
+                    return c.restore(
+                        os.path.abspath(path),
+                        args=ocp.args.PyTreeRestore(item=tree,
+                                                    restore_args=rargs))
+            return ckpt.restore(os.path.abspath(path), tree)
+
+        try:
+            return _restore(structs)
+        except ValueError as e:
+            # None shardings are rejected when the checkpoint's saved device
+            # topology is not resolvable in this process (e.g. the export
+            # CLI reading a checkpoint written under a simulated multi-device
+            # mesh): pin those leaves to one local device and retry. Retry
+            # ONLY for that condition — any other ValueError (shape/template
+            # mismatch) would just fail again after a multi-GB re-read.
+            if not had_none or "sharding" not in str(e):
+                raise
+            dev0 = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+            pinned = jax.tree.map(
+                lambda s: s if s is ocp.PLACEHOLDER
+                or getattr(s, "sharding", 1) is not None
+                else jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=dev0),
+                structs)
+            return _restore(pinned)
     return ckpt.restore(os.path.abspath(path))

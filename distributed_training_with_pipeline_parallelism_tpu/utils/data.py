@@ -111,6 +111,61 @@ def encode_text_file(text_path: str, out_path: str) -> int:
     return int(data.size)
 
 
+def encode_text_file_hf(text_path: str, out_path: str,
+                        tokenizer="gpt2",
+                        chunk_chars: int = 1 << 20) -> int:
+    """Tokenize a UTF-8 text file into the packed format with a Hugging Face
+    tokenizer. ``tokenizer`` is a name/path for
+    ``AutoTokenizer.from_pretrained`` ("gpt2" BPE by default — pair with the
+    gpt2-* model family and its 50257 vocab) or an already-constructed
+    tokenizer object (offline environments). Streams in
+    ``chunk_chars``-character chunks so arbitrarily large corpora encode in
+    bounded memory. Returns the token count.
+
+    uint16 packs vocabs < 65536 (GPT-2's 50257 fits); larger tokenizers fall
+    back to uint32 automatically (``TokenFileDataset(dtype=np.uint32)`` to
+    read those).
+    """
+    if isinstance(tokenizer, str):
+        from transformers import AutoTokenizer
+        tok = AutoTokenizer.from_pretrained(tokenizer)
+    else:
+        tok = tokenizer
+    dtype = np.uint16 if len(tok) < (1 << 16) else np.uint32
+    n = 0
+
+    def emit(text, out):
+        nonlocal n
+        # add_special_tokens=False: a BOS/EOS-adding tokenizer (Llama) must
+        # not inject special tokens at arbitrary chunk boundaries of one
+        # continuous corpus
+        ids = np.asarray(tok(text, add_special_tokens=False)["input_ids"],
+                         dtype=dtype)
+        ids.tofile(out)
+        n += int(ids.size)
+
+    carry = ""
+    with open(text_path, encoding="utf-8") as src, open(out_path, "wb") as out:
+        while True:
+            chunk = src.read(chunk_chars)
+            if not chunk:
+                break
+            chunk = carry + chunk
+            # cut at the last whitespace so no word (or BPE merge) straddles
+            # a chunk boundary; the whitespace travels with the NEXT chunk
+            # (GPT-2-style BPE attaches the leading space to the word)
+            cut = max(chunk.rfind(" "), chunk.rfind("\n"))
+            if cut <= 0:
+                carry = ""
+                emit(chunk, out)
+            else:
+                carry = chunk[cut:]
+                emit(chunk[:cut], out)
+        if carry:
+            emit(carry, out)
+    return n
+
+
 def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> Optional[NamedSharding]:
     """Sharding for [B, S] batches: batch dim split over the mesh's data
     axis (replicated over the other axes). Returns None if the mesh has no

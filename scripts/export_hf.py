@@ -1,0 +1,85 @@
+"""Export a trained checkpoint to a Hugging Face ``save_pretrained`` dir.
+
+    python scripts/export_hf.py --model gpt2-small --ckpt /tmp/ckpt \
+        --out /tmp/hf_model
+
+``--ckpt`` accepts the same layouts scripts/train.py --resume does: a fit()
+checkpoint dir of ``step_N/`` trees, a single ``step_N`` dir, or a bare
+params checkpoint. The exported dir loads with
+``transformers.AutoModelForCausalLM.from_pretrained``.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True,
+                    help="gpt2-{small,medium,large,xl}, llama2-7b, "
+                         "llama3-8b, mistral-7b-v0.1, llama-debug")
+    ap.add_argument("--ckpt", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--dim", type=int, default=0)
+    ap.add_argument("--ffn", type=int, default=0)
+    ap.add_argument("--layers", type=int, default=0)
+    ap.add_argument("--heads", type=int, default=0)
+    ap.add_argument("--vocab", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+
+    from distributed_training_with_pipeline_parallelism_tpu.models import (
+        transformer as tfm)
+    from distributed_training_with_pipeline_parallelism_tpu.models.gpt2 import (
+        gpt2_config)
+    from distributed_training_with_pipeline_parallelism_tpu.models.hf import to_hf
+    from distributed_training_with_pipeline_parallelism_tpu.models.llama import (
+        llama_config)
+    from distributed_training_with_pipeline_parallelism_tpu.utils import train
+    from distributed_training_with_pipeline_parallelism_tpu.utils.checkpoint import (
+        restore_checkpoint)
+
+    overrides = {k: v for k, v in dict(
+        dim=args.dim, ffn_dim=args.ffn, n_layers=args.layers,
+        n_heads=args.heads, vocab_size=args.vocab).items() if v}
+    if args.model.startswith("gpt2-"):
+        cfg = gpt2_config(args.model.removeprefix("gpt2-"), **overrides)
+    elif args.model.startswith(("llama", "mistral")):
+        cfg = llama_config(args.model, **overrides)
+    else:
+        raise SystemExit(f"unknown model {args.model} (ref_decoder has no "
+                         f"HF equivalent)")
+
+    params_t = jax.eval_shape(
+        lambda: tfm.transformer_init(jax.random.key(0), cfg))
+    path = args.ckpt
+    latest = train._latest_step_dir(path)
+    if latest is not None:
+        path = latest[1]
+    base = os.path.basename(os.path.normpath(path))
+    if base.startswith("step_"):
+        import jax.numpy as jnp
+        import orbax.checkpoint as ocp
+        # opt_state as PLACEHOLDER leaves: orbax skips them entirely, so a
+        # 7B-class export never materializes the (2x-params) Adam moments
+        opt_t = jax.tree.map(lambda _: ocp.PLACEHOLDER,
+                             jax.eval_shape(train.adamw().init, params_t))
+        state = restore_checkpoint(path, template={
+            "params": params_t, "opt_state": opt_t, "step": jnp.asarray(0)})
+        params = state["params"]
+    else:
+        params = restore_checkpoint(path, template=params_t)
+    print(f"loaded {path}", flush=True)
+
+    model = to_hf(cfg, params)
+    model.save_pretrained(args.out)
+    print(f"exported {args.model} -> {args.out} "
+          f"({model.num_parameters():,} params)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
