@@ -350,7 +350,6 @@ def to_hf(cfg: ModelConfig, params: Pytree):
         missing, unexpected = model.load_state_dict(
             {k: torch.from_numpy(np.array(v)) for k, v in sd.items()},
             strict=False)
-    unexpected = [k for k in unexpected]
     # rotary inv_freq buffers etc. may be "missing" (they are derived);
     # a real weight missing or an unknown key is a conversion bug
     real_missing = [k for k in missing if "inv_freq" not in k]

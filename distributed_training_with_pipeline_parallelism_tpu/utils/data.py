@@ -25,6 +25,7 @@ on the GPT-2/Llama ladder needs an actual input pipeline, TPU-shaped:
 from __future__ import annotations
 
 import collections
+import os
 from typing import Iterator, Optional, Tuple
 
 import jax
@@ -59,17 +60,33 @@ def synthetic_batches(vocab_size: int, batch_size: int, seq_length: int,
                                 dtype=np.int32))
 
 
+def token_file_dtype(path: str, default: np.dtype = np.uint16) -> np.dtype:
+    """The element dtype of a packed token file: the ``<path>.meta.json``
+    sidecar's ``dtype`` entry when present (written by
+    :func:`encode_text_file_hf` for >=2^16 vocabs), else ``default``
+    (uint16, the standard packed-corpus format)."""
+    import json
+    meta = os.fspath(path) + ".meta.json"
+    if os.path.exists(meta):
+        with open(meta) as f:
+            return np.dtype(json.load(f).get("dtype", default))
+    return np.dtype(default)
+
+
 class TokenFileDataset:
     """Random-crop sampler over a flat binary token file.
 
     ``path`` holds token ids as a flat array of ``dtype`` (uint16 fits any
-    vocab < 65536 — the standard packed-corpus format). Batches are
-    independent random crops of ``seq_length + 1`` tokens; targets are the
-    crop shifted by one.
+    vocab < 65536 — the standard packed-corpus format; ``dtype=None``
+    consults the ``.meta.json`` sidecar so uint32 corpora from large-vocab
+    tokenizers read correctly with no flag). Batches are independent random
+    crops of ``seq_length + 1`` tokens; targets are the crop shifted by one.
     """
 
     def __init__(self, path: str, seq_length: int,
-                 dtype: np.dtype = np.uint16, seed: int = 0):
+                 dtype: Optional[np.dtype] = None, seed: int = 0):
+        if dtype is None:
+            dtype = token_file_dtype(path)
         self.tokens = np.memmap(path, dtype=dtype, mode="r")
         if len(self.tokens) < seq_length + 1:
             raise ValueError(
@@ -132,6 +149,13 @@ def encode_text_file_hf(text_path: str, out_path: str,
     else:
         tok = tokenizer
     dtype = np.uint16 if len(tok) < (1 << 16) else np.uint32
+    if dtype != np.uint16:
+        # non-default element width: record it in a sidecar so readers
+        # (TokenFileDataset dtype=None) pick it up — a uint32 file silently
+        # read as uint16 would train on garbage half-tokens
+        import json
+        with open(out_path + ".meta.json", "w") as f:
+            json.dump({"dtype": "uint32", "vocab_size": len(tok)}, f)
     n = 0
 
     def emit(text, out):

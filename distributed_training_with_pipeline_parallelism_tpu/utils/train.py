@@ -213,7 +213,9 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         sp_attn_impl: str = "ring", tp_vocab_parallel: bool = False,
         zero1: bool = False, dropout_seed: int = 0,
         eval_data: Optional[Callable[[], Iterator]] = None,
-        eval_every: int = 0, eval_batches: int = 8):
+        eval_every: int = 0, eval_batches: int = 8,
+        profile_dir: Optional[str] = None,
+        profile_steps: Tuple[int, int] = (2, 5)):
     """Training loop over a ``(tokens, targets)`` iterator.
 
     Returns (params, list of (step, loss)). The data contract matches the
@@ -240,6 +242,9 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
       held-out batches are scored every time); results go to the metrics
       stream and (``verbose``) stdout. Eval runs in eval mode
       (no dropout) on the forward-only pipelined loss where the mesh allows.
+    - ``profile_dir``: capture a ``jax.profiler`` trace (XProf/TensorBoard)
+      of steps ``profile_steps`` = [start, end) — default (2, 5): past the
+      compile step, three steady-state steps.
     """
     optimizer = optimizer or adamw(total_steps=num_steps)
     step_fn = make_train_step(cfg, mesh, sched, optimizer, moe=moe,
@@ -299,7 +304,21 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
     history = []
     window_start = time.perf_counter()
     window_tokens = 0
+    profiling = False
+    # profile_steps counts from the first step THIS run executes, so a
+    # resumed job still captures a window instead of silently skipping it
+    prof_start = start_step + profile_steps[0]
+    prof_stop = start_step + max(profile_steps[1], profile_steps[0] + 1)
     for i in range(start_step, num_steps):
+        if profile_dir is not None:
+            if i == prof_start and not profiling:
+                jax.profiler.start_trace(profile_dir)
+                profiling = True
+            elif i == prof_stop and profiling:
+                jax.profiler.stop_trace()
+                profiling = False
+                if verbose:
+                    print(f"profile trace written to {profile_dir}", flush=True)
         tokens, targets = next(data)
         if drop_key is not None:
             params, opt_state, loss = step_fn(
@@ -332,6 +351,8 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         if (checkpoint_dir and checkpoint_every
                 and (i + 1) % checkpoint_every == 0 and i != num_steps - 1):
             _save(i)
+    if profiling:  # profile window ran past the last step
+        jax.profiler.stop_trace()
     if eval_fn is not None and num_steps > start_step:
         _eval(num_steps - 1)
     if checkpoint_dir and checkpoint_every and num_steps > start_step:

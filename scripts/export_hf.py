@@ -43,16 +43,24 @@ def main():
     from distributed_training_with_pipeline_parallelism_tpu.utils.checkpoint import (
         restore_checkpoint)
 
+    def build_cfg(**overrides):
+        if args.model.startswith("gpt2-"):
+            return gpt2_config(args.model.removeprefix("gpt2-"), **overrides)
+        if args.model.startswith(("llama", "mistral")):
+            return llama_config(args.model, **overrides)
+        raise SystemExit(f"unknown model {args.model} (ref_decoder has no "
+                         f"HF equivalent)")
+
     overrides = {k: v for k, v in dict(
         dim=args.dim, ffn_dim=args.ffn, n_layers=args.layers,
         n_heads=args.heads, vocab_size=args.vocab).items() if v}
-    if args.model.startswith("gpt2-"):
-        cfg = gpt2_config(args.model.removeprefix("gpt2-"), **overrides)
-    elif args.model.startswith(("llama", "mistral")):
-        cfg = llama_config(args.model, **overrides)
-    else:
-        raise SystemExit(f"unknown model {args.model} (ref_decoder has no "
-                         f"HF equivalent)")
+    if args.dim and not args.ffn:
+        # mirror scripts/train.py: keep the family's FFN:dim ratio when the
+        # width was scaled, else the restore template mismatches the
+        # checkpoint trained with that derived ffn_dim
+        base = build_cfg()
+        overrides["ffn_dim"] = max(1, round(base.ffn_dim * args.dim / base.dim))
+    cfg = build_cfg(**overrides)
 
     params_t = jax.eval_shape(
         lambda: tfm.transformer_init(jax.random.key(0), cfg))

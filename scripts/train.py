@@ -67,6 +67,9 @@ def main():
                     help="resume from the newest checkpoint in --ckpt")
     ap.add_argument("--metrics", default="",
                     help="append per-log-point JSON lines here")
+    ap.add_argument("--profile", default="",
+                    help="capture a jax.profiler trace of 3 steady-state "
+                         "steps into this dir (view in XProf/TensorBoard)")
     ap.add_argument("--resume", default="", help="params checkpoint to load")
     ap.add_argument("--simulate-devices", type=int, default=0)
     # overrides to scale models down for smoke runs
@@ -217,7 +220,14 @@ def main():
         params = init_params(jax.random.key(args.seed))
 
     from distributed_training_with_pipeline_parallelism_tpu.utils.data import (
-        TokenFileDataset, batch_sharding, prefetch_to_device)
+        TokenFileDataset, batch_sharding, prefetch_to_device,
+        token_file_dtype)
+    import numpy as np
+    if (args.data_file and args.native_loader
+            and token_file_dtype(args.data_file) != np.uint16):
+        raise SystemExit("--native-loader reads uint16 token files; this "
+                         "corpus's .meta.json sidecar says otherwise — "
+                         "drop --native-loader for it")
     if args.data_file and args.native_loader:
         from distributed_training_with_pipeline_parallelism_tpu.utils.data_native import (
             NativeTokenLoader)
@@ -235,9 +245,14 @@ def main():
 
     eval_data = None
     if args.eval_every:
-        if args.eval_file:
+        # --eval-file if given; else the training file (NOT held out — still
+        # useful as a fixed-batch progress probe); synthetic only when
+        # training is synthetic too (scoring a real-text model on random
+        # tokens would read as a huge, meaningless loss)
+        eval_src = args.eval_file or args.data_file
+        if eval_src:
             eval_data = lambda: TokenFileDataset(  # noqa: E731
-                args.eval_file, args.seq, seed=123).batches(args.batch)
+                eval_src, args.seq, seed=123).batches(args.batch)
         else:
             eval_data = lambda: train.synthetic_data(  # noqa: E731
                 cfg, args.batch, args.seq, seed=123)
@@ -251,7 +266,8 @@ def main():
         sp_attn_impl=args.sp_attn, tp_vocab_parallel=args.vocab_parallel,
         zero1=args.zero1, dropout_seed=args.seed,
         eval_data=eval_data, eval_every=args.eval_every,
-        eval_batches=args.eval_batches)
+        eval_batches=args.eval_batches,
+        profile_dir=args.profile or None)
     if args.ckpt:
         print(f"checkpoints in {args.ckpt}", flush=True)
     if history:

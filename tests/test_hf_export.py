@@ -118,3 +118,29 @@ def test_encode_text_file_hf(tmp_path):
                              chunk_chars=7)
     assert n2 == n
     assert out.read_bytes() == out2.read_bytes()
+
+
+def test_encode_large_vocab_uint32_sidecar(tmp_path):
+    """A >=2^16-vocab tokenizer writes uint32 + a sidecar, and
+    TokenFileDataset reads it back correctly with no dtype flag."""
+    from distributed_training_with_pipeline_parallelism_tpu.utils.data import (
+        TokenFileDataset, encode_text_file_hf, token_file_dtype)
+
+    class BigVocabTok:
+        def __len__(self):
+            return 1 << 17
+
+        def __call__(self, text, add_special_tokens=True):
+            # deterministic fake ids above the uint16 range
+            return {"input_ids": [65536 + (ord(c) % 1000)
+                                  for c in text if not c.isspace()]}
+
+    src = tmp_path / "c.txt"
+    src.write_text("ab cd ef gh ij kl mn op qr st uv wx yz 01 23 45")
+    out = tmp_path / "c.bin"
+    n = encode_text_file_hf(str(src), str(out), tokenizer=BigVocabTok())
+    assert np.dtype(token_file_dtype(str(out))) == np.uint32
+    ds = TokenFileDataset(str(out), seq_length=8)  # dtype from sidecar
+    x, _ = ds.sample(2)
+    assert int(x.min()) >= 65536  # read as real uint32 ids, not split halves
+    assert n == len(ds)
