@@ -107,6 +107,8 @@ def _forward_with_cache(cfg: ModelConfig, params: Pytree, cache: Pytree,
         raise ValueError(
             f"generation is undefined for arch {cfg.arch!r}: the reference "
             "block is non-causal with no positional encoding (SURVEY.md C2)")
+    from .transformer import compute_cast
+    params = compute_cast(cfg, params)  # decode in the compute dtype too
     b, s = tokens.shape
     h = embedding_apply(params["embed"]["tok"], tokens)
     if cfg.arch == "gpt2":

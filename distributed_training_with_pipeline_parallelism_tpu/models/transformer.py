@@ -182,10 +182,20 @@ def transformer_init(key: jax.Array, cfg: ModelConfig) -> Dict:
         head = {"norm": layer_norm_init(cfg.dim),
                 "out": linear_init(ko, cfg.dim, cfg.vocab_size, bias=cfg.arch == "ref_decoder")}
     params = {"embed": embed, "layers": layers, "head": head}
-    dtype = jnp.dtype(cfg.dtype)
+    dtype = jnp.dtype(cfg.storage_dtype)  # master-weight dtype under mixing
     if dtype != jnp.float32:
         params = jax.tree.map(lambda x: x.astype(dtype), params)
     return params
+
+
+def compute_cast(cfg: ModelConfig, tree: Dict) -> Dict:
+    """Cast a parameter (sub)tree from storage to compute dtype. Identity
+    when no mixed precision is configured. Sits INSIDE autodiff at every
+    use site, so cotangents flow back in the storage dtype."""
+    if not cfg.mixed_precision:
+        return tree
+    dtype = jnp.dtype(cfg.dtype)
+    return jax.tree.map(lambda x: x.astype(dtype), tree)
 
 
 def embed_apply(cfg: ModelConfig, embed: Dict, tokens: jax.Array,
@@ -254,6 +264,7 @@ def transformer_apply(cfg: ModelConfig, params: Dict, tokens: jax.Array,
     executor uses per microbatch, so executor masks are checkable against
     this path."""
     rng_e = None if rng is None else jax.random.fold_in(rng, cfg.n_layers)
+    params = compute_cast(cfg, params)  # bf16 compute over fp32 masters
     h = embed_apply(cfg, params["embed"], tokens, rng=rng_e)
     h = body_apply(cfg, params["layers"], h, rng=rng)
     return head_apply(cfg, params["head"], h)

@@ -44,8 +44,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.transformer import (body_apply, embed_apply, head_apply,
-                                  head_norm_apply, transformer_loss)
+from ..models.transformer import (body_apply, compute_cast, embed_apply,
+                                  head_apply, head_norm_apply,
+                                  transformer_loss)
 from ..ops.layers import linear_apply, select_xent
 from ..utils.config import ModelConfig, ScheduleConfig
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS,
@@ -253,6 +254,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             ``(vv, mm)`` select the dropout stream (train mode): the stack's
             global layer offset is ``(vv*D + d) * lps``."""
             zero = jnp.zeros((), jnp.float32)
+            layer_p = compute_cast(cfg, layer_p)  # bf16 compute, fp32 masters
             if moe is not None:
                 from ..models.moe import moe_layer_apply
 
@@ -277,6 +279,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                                   tp_axis=tp_axis, tp_size=T), zero)
 
         def stage_embed(embed_p, toks, mm=0):
+            embed_p = compute_cast(cfg, embed_p)
             if sp_axis is None:
                 rng_mb = mb_rng(mm)
                 rng_e = (None if rng_mb is None
@@ -311,6 +314,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             is what the tick accumulates into the reported loss. ``(vv, mm)``
             select the dropout stream, so the rematerialized forward here
             draws exactly the masks the forward unit drew."""
+            head_p = compute_cast(cfg, head_p)
             y, aux = stage_body(p_v, x_in, vv, mm)
 
             def loss_branch():
@@ -663,7 +667,10 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
     def spmd_fn(layers_stacked, embed, head, tokens, targets):
         d = jax.lax.axis_index(PIPE_AXIS)
-        layers_local = jax.tree.map(lambda x: x[0, 0], layers_stacked)
+        layers_local = compute_cast(
+            cfg, jax.tree.map(lambda x: x[0, 0], layers_stacked))
+        embed = compute_cast(cfg, embed)
+        head = compute_cast(cfg, head)
         b_local, seq = tokens.shape
         assert b_local % M == 0, (
             f"local batch {b_local} not divisible by n_microbatches={M}")
@@ -753,7 +760,10 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
     def spmd_fn(layers_stacked, embed, head, tokens):
         d = jax.lax.axis_index(PIPE_AXIS)
-        layers_local = jax.tree.map(lambda x: x[0, 0], layers_stacked)
+        layers_local = compute_cast(
+            cfg, jax.tree.map(lambda x: x[0, 0], layers_stacked))
+        embed = compute_cast(cfg, embed)
+        head = compute_cast(cfg, head)
         b_local, seq = tokens.shape
         assert b_local % M == 0, (
             f"local batch {b_local} not divisible by n_microbatches={M}")

@@ -96,3 +96,23 @@ def restore_checkpoint(path: str, template: Optional[Pytree] = None) -> Pytree:
                 structs)
             return _restore(pinned)
     return ckpt.restore(os.path.abspath(path))
+
+
+def restore_subtree(path: str, key: str, template: Pytree) -> Pytree:
+    """Restore only ``state[key]`` from a checkpoint, never reading the rest
+    from disk — and without needing to know the rest's structure (the export
+    CLI can't: the saved opt_state depends on optimizer/grad-accum options).
+    The checkpoint's own metadata supplies the full tree; every subtree but
+    ``key`` becomes a PLACEHOLDER."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as c:
+        md = c.metadata(os.path.abspath(path)).item_metadata.tree
+    full = jax.tree.map(lambda _: ocp.PLACEHOLDER, md)
+    if not isinstance(full, dict) or key not in full:
+        raise KeyError(
+            f"checkpoint at {path} has no {key!r} subtree "
+            f"(top-level keys: {sorted(full) if isinstance(full, dict) else type(full)})")
+    full[key] = template
+    return restore_checkpoint(path, template=full)[key]

@@ -44,6 +44,13 @@ class ModelConfig:
     # Active only when an rng is passed to the apply/loss/pipeline functions
     # (train mode); calls without an rng always run deterministically.
     dtype: str = "float32"
+    # Mixed-precision master weights: store parameters in this dtype while
+    # computing in ``dtype``. None = same as ``dtype`` (no mixing). The
+    # standard TPU recipe is dtype="bfloat16", param_dtype="float32": MXU
+    # matmuls run bf16, but weights, gradient accumulation, and optimizer
+    # moments stay fp32 (the cast sits inside autodiff, so grads come back
+    # fp32 automatically).
+    param_dtype: Optional[str] = None
     use_flash_attention: bool = False  # route attention through the Pallas kernel
     use_fused_xent: bool = False  # route the loss through the Pallas fused-CE kernel
     remat_layers: bool = False  # jax.checkpoint each layer: trade FLOPs for HBM
@@ -84,6 +91,15 @@ class ModelConfig:
     @property
     def causal(self) -> bool:
         return self.arch != "ref_decoder"
+
+    @property
+    def storage_dtype(self) -> str:
+        """The dtype parameters are stored in (param_dtype, else dtype)."""
+        return self.param_dtype or self.dtype
+
+    @property
+    def mixed_precision(self) -> bool:
+        return self.storage_dtype != self.dtype
 
     @property
     def head_dim(self) -> int:

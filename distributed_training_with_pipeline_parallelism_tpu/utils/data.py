@@ -149,13 +149,18 @@ def encode_text_file_hf(text_path: str, out_path: str,
     else:
         tok = tokenizer
     dtype = np.uint16 if len(tok) < (1 << 16) else np.uint32
+    sidecar = os.fspath(out_path) + ".meta.json"
     if dtype != np.uint16:
         # non-default element width: record it in a sidecar so readers
         # (TokenFileDataset dtype=None) pick it up — a uint32 file silently
         # read as uint16 would train on garbage half-tokens
         import json
-        with open(out_path + ".meta.json", "w") as f:
+        with open(sidecar, "w") as f:
             json.dump({"dtype": "uint32", "vocab_size": len(tok)}, f)
+    elif os.path.exists(sidecar):
+        # re-encoding the same path with a small-vocab tokenizer: a stale
+        # uint32 sidecar would make readers mis-type the fresh uint16 file
+        os.remove(sidecar)
     n = 0
 
     def emit(text, out):

@@ -215,7 +215,8 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         eval_data: Optional[Callable[[], Iterator]] = None,
         eval_every: int = 0, eval_batches: int = 8,
         profile_dir: Optional[str] = None,
-        profile_steps: Tuple[int, int] = (2, 5)):
+        profile_steps: Tuple[int, int] = (2, 5),
+        grad_accum: int = 1):
     """Training loop over a ``(tokens, targets)`` iterator.
 
     Returns (params, list of (step, loss)). The data contract matches the
@@ -245,8 +246,20 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
     - ``profile_dir``: capture a ``jax.profiler`` trace (XProf/TensorBoard)
       of steps ``profile_steps`` = [start, end) — default (2, 5): past the
       compile step, three steady-state steps.
+    - ``grad_accum``: average gradients over k data batches before each
+      optimizer update (``optax.MultiSteps``) — accumulation ACROSS steps,
+      on top of the within-step microbatch accumulation the pipeline
+      schedule already performs. k accumulated steps on batch B step the
+      optimizer exactly as one step on batch k*B would. ``num_steps``
+      counts data batches, so optimizer updates = num_steps / k.
     """
-    optimizer = optimizer or adamw(total_steps=num_steps)
+    if optimizer is None:
+        # the LR schedule advances once per OPTIMIZER update, which under
+        # grad_accum happens every k data batches — size its horizon in
+        # updates, not batches, or warmup/decay stretch k times too long
+        optimizer = adamw(total_steps=max(1, num_steps // grad_accum))
+    if grad_accum > 1:
+        optimizer = optax.MultiSteps(optimizer, every_k_schedule=grad_accum)
     step_fn = make_train_step(cfg, mesh, sched, optimizer, moe=moe,
                               sp_attn_impl=sp_attn_impl,
                               tp_vocab_parallel=tp_vocab_parallel)

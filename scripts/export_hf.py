@@ -70,15 +70,12 @@ def main():
         path = latest[1]
     base = os.path.basename(os.path.normpath(path))
     if base.startswith("step_"):
-        import jax.numpy as jnp
-        import orbax.checkpoint as ocp
-        # opt_state as PLACEHOLDER leaves: orbax skips them entirely, so a
-        # 7B-class export never materializes the (2x-params) Adam moments
-        opt_t = jax.tree.map(lambda _: ocp.PLACEHOLDER,
-                             jax.eval_shape(train.adamw().init, params_t))
-        state = restore_checkpoint(path, template={
-            "params": params_t, "opt_state": opt_t, "step": jnp.asarray(0)})
-        params = state["params"]
+        # params-only restore: never materializes the (2x-params) Adam
+        # moments, and works whatever the saved opt_state's structure is
+        # (plain AdamW, --grad-accum MultiSteps wrapping, ...)
+        from distributed_training_with_pipeline_parallelism_tpu.utils.checkpoint import (
+            restore_subtree)
+        params = restore_subtree(path, "params", params_t)
     else:
         params = restore_checkpoint(path, template=params_t)
     print(f"loaded {path}", flush=True)

@@ -46,6 +46,13 @@ def main():
                          "materialize full-size)")
     ap.add_argument("--virtual", type=int, default=1)
     ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="accumulate gradients over k batches per optimizer "
+                         "update (on top of per-step microbatching)")
+    ap.add_argument("--param-dtype", default="",
+                    help="master-weight dtype; 'float32' with "
+                         "--dtype bfloat16 is the mixed-precision recipe "
+                         "(bf16 compute, fp32 weights/grads/moments)")
     ap.add_argument("--steps", type=int, default=50)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=128)
@@ -158,6 +165,8 @@ def main():
         n_heads=args.heads, vocab_size=args.vocab,
     ).items() if v}
     overrides["dtype"] = args.dtype
+    if args.param_dtype:
+        overrides["param_dtype"] = args.param_dtype
     if args.dropout:
         overrides["dropout"] = args.dropout
     if args.flash:
@@ -267,7 +276,7 @@ def main():
         zero1=args.zero1, dropout_seed=args.seed,
         eval_data=eval_data, eval_every=args.eval_every,
         eval_batches=args.eval_batches,
-        profile_dir=args.profile or None)
+        profile_dir=args.profile or None, grad_accum=args.grad_accum)
     if args.ckpt:
         print(f"checkpoints in {args.ckpt}", flush=True)
     if history:
