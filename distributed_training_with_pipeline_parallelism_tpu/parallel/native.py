@@ -112,10 +112,11 @@ def compile_schedule_native(name: str, n_devices: int, n_virtual: int,
         err, len(err))
     if rc != 0:
         raise ScheduleError(err.value.decode())
+    from .schedules import is_split_backward
     cs = CompiledSchedule(
         name=name, n_devices=n_devices, n_virtual=n_virtual,
         n_microbatches=n_microbatches, table=table[: t_out.value].copy(),
         makespan=t_out.value, ticks={}, n_act_slots=n_act.value,
-        n_grad_slots=n_grad.value)
+        n_grad_slots=n_grad.value, split_backward=is_split_backward(name))
     verify_table(cs)
     return cs

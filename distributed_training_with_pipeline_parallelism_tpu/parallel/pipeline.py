@@ -71,8 +71,13 @@ Pytree = Any
 
 def _compile(name: str, D: int, V: int, M: int) -> CompiledSchedule:
     """Compile via the native C++ engine when available (bit-identical to the
-    Python compiler — see tests/test_native_engine.py), else in Python."""
+    Python compiler — see tests/test_native_engine.py), else in Python.
+    Custom registered schedules always compile in Python (their order
+    functions are Python)."""
     from . import native
+    from .schedules import is_custom
+    if is_custom(name):
+        return compile_schedule(name, D, V, M)
     if native.native_available():
         from .schedules import ScheduleError
         try:

@@ -41,6 +41,55 @@ W = "W"  # weight-grad (wgrad) — split schedules (ZB-H1) only
 
 SPLIT_BACKWARD_SCHEDULES = frozenset({"ZBH1"})
 
+# User-registered schedules: name -> (order_fn, split_backward).
+# ``order_fn(n_devices, n_virtual, n_microbatches) -> List[List[Action]]``.
+_CUSTOM_SCHEDULES: Dict[str, Tuple[object, bool]] = {}
+
+
+def register_schedule(name: str, order_fn, split_backward: bool = False,
+                      overwrite: bool = False) -> None:
+    """Register a custom pipeline schedule under ``name``.
+
+    ``order_fn(n_devices, n_virtual, n_microbatches)`` returns per-device
+    action lists using this module's :class:`Action` (wrap placement:
+    device(stage) = stage % n_devices). The order is validated, deadlock-
+    checked, tick-scheduled, slot-allocated, and symbolically verified by
+    the same machinery as the built-ins, then runs on the unmodified SPMD
+    executor — the whole point of keeping the schedule as data
+    (upstream torch gates this behind ``_PipelineScheduleRuntime``'s CSV
+    loader, ``schedules.py:2279``; here it is a first-class API, tested in
+    tests/test_custom_schedule.py). With ``split_backward`` the order must
+    emit dgrad ``B`` + wgrad ``W`` pairs per ZB-H1 conventions (no ``B``
+    on stage 0).
+    """
+    if not overwrite and (name in BUILTIN_SCHEDULE_NAMES
+                          or name in _CUSTOM_SCHEDULES):
+        raise ScheduleError(f"schedule {name!r} already exists")
+    if name in BUILTIN_SCHEDULE_NAMES:
+        raise ScheduleError(f"cannot overwrite built-in schedule {name!r}")
+    _CUSTOM_SCHEDULES[name] = (order_fn, split_backward)
+
+
+def unregister_schedule(name: str) -> None:
+    _CUSTOM_SCHEDULES.pop(name, None)
+
+
+def is_split_backward(name: str) -> bool:
+    if name in _CUSTOM_SCHEDULES:
+        return _CUSTOM_SCHEDULES[name][1]
+    return name in SPLIT_BACKWARD_SCHEDULES
+
+
+def is_custom(name: str) -> bool:
+    return name in _CUSTOM_SCHEDULES
+
+
+def schedule_names() -> Tuple[str, ...]:
+    return BUILTIN_SCHEDULE_NAMES + tuple(_CUSTOM_SCHEDULES)
+
+
+BUILTIN_SCHEDULE_NAMES = ("GPipe", "1F1B", "Interleaved1F1B", "ZBH1", "BFS")
+
 
 @dataclasses.dataclass(frozen=True)
 class Action:
@@ -235,6 +284,8 @@ def zb_h1_order(n_devices: int, n_microbatches: int) -> List[List[Action]]:
 
 def build_order(name: str, n_devices: int, n_virtual: int,
                 n_microbatches: int) -> List[List[Action]]:
+    if name in _CUSTOM_SCHEDULES:
+        return _CUSTOM_SCHEDULES[name][0](n_devices, n_virtual, n_microbatches)
     if name == "ZBH1":
         if n_virtual != 1:
             raise ScheduleError("ZBH1 supports a single stage per device")
@@ -389,16 +440,16 @@ class CompiledSchedule:
     ticks: Dict[Action, int]
     n_act_slots: int
     n_grad_slots: int
+    # True when B actions are dgrad-only and W actions carry the parameter
+    # gradients (ZB-H1 family; custom schedules declare it at registration).
+    # Captured at compile time — a live registry lookup would let a later
+    # unregister/overwrite silently change an already-compiled schedule's
+    # semantics.
+    split_backward: bool = False
 
     @property
     def n_stages(self) -> int:
         return self.n_devices * self.n_virtual
-
-    @property
-    def split_backward(self) -> bool:
-        """True when B actions are dgrad-only and W actions carry the
-        parameter gradients (ZB-H1 family)."""
-        return self.name in SPLIT_BACKWARD_SCHEDULES
 
 
 def _allocate_slots(events: List[Tuple[int, int, object]]) -> Tuple[Dict[object, int], int]:
@@ -441,7 +492,7 @@ def compile_schedule(name: str, n_devices: int, n_virtual: int,
     :func:`verify_table` (a symbolic interpreter) before being returned.
     """
     D, V, M = n_devices, n_virtual, n_microbatches
-    split = name in SPLIT_BACKWARD_SCHEDULES
+    split = is_split_backward(name)
     orders = build_order(name, D, V, M)
     validate_order(orders, D, V, M, split_backward=split)
     ticks, T_compute = schedule_ticks(orders, D, V)
@@ -519,7 +570,8 @@ def compile_schedule(name: str, n_devices: int, n_virtual: int,
     # Trim trailing all-empty ticks (keeps the executor scan minimal).
     while T > 1 and np.all(table[T - 1] == -1):
         T -= 1
-    cs = CompiledSchedule(name, D, V, M, table[:T], T, ticks, n_act, n_grad)
+    cs = CompiledSchedule(name, D, V, M, table[:T], T, ticks, n_act, n_grad,
+                          split_backward=split)
     verify_table(cs)
     return cs
 
@@ -617,7 +669,8 @@ def verify_table(cs: CompiledSchedule) -> None:
 
 
 def analytic_bubble_fraction(name: str, n_devices: int, n_virtual: int,
-                             n_microbatches: int) -> float:
+                             n_microbatches: int,
+                             cs: "CompiledSchedule" = None) -> float:
     """Ideal bubble fraction in unit-cost ticks.
 
     GPipe / 1F1B: (D-1)/(M + D - 1) — the classic fill/drain bubble (1F1B
@@ -630,6 +683,14 @@ def analytic_bubble_fraction(name: str, n_devices: int, n_virtual: int,
     1F1B shows in :func:`simulated_bubble` with w_b=w_w=1 vs full w_b=2).
     """
     D, M = n_devices, n_microbatches
+    if name in _CUSTOM_SCHEDULES:
+        # no closed form for arbitrary registered orders: report the
+        # unit-cost tick simulation, which IS the executor's time model
+        # (pass the caller's already-compiled ``cs`` to skip a recompile)
+        if cs is None:
+            cs = compile_schedule(name, D, n_virtual, M)
+        return simulated_bubble(cs, w_f=1.0, w_b=1.0, w_w=1.0)[
+            "bubble_fraction"]
     if name == "ZBH1":
         return (D - 1) / (3 * M + D - 1)
     V = n_virtual if name in ("Interleaved1F1B", "BFS") else 1

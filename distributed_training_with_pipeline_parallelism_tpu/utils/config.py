@@ -140,16 +140,28 @@ class ScheduleConfig:
 
     def __post_init__(self):
         if self.name not in SCHEDULE_NAMES:
-            raise ValueError(f"unknown schedule {self.name!r}; expected one of {SCHEDULE_NAMES}")
+            # custom schedules registered via parallel.schedules.register_schedule
+            from ..parallel.schedules import schedule_names
+            if self.name not in schedule_names():
+                raise ValueError(
+                    f"unknown schedule {self.name!r}; expected one of "
+                    f"{schedule_names()}")
 
 
-SCHEDULE_NAMES = ("GPipe", "1F1B", "Interleaved1F1B", "ZBH1", "BFS")
+# The single source of builtin names is the schedule module; re-exported here
+# because config is the user-facing surface (CLIs use it for --schedule).
+from ..parallel.schedules import BUILTIN_SCHEDULE_NAMES as SCHEDULE_NAMES  # noqa: E402
 
 
 def virtual_stages_for(schedule_name: str, n_layers: int, n_pipe: int) -> int:
-    """Reference rule for stages-per-worker (``LLMsDistributedTrainingHelper.py:181-185``)."""
+    """Reference rule for stages-per-worker (``LLMsDistributedTrainingHelper.py:181-185``).
+    Custom registered schedules get 1 (the rule only special-cases
+    Interleaved)."""
     if schedule_name not in SCHEDULE_NAMES:
-        raise ValueError(f"unknown schedule {schedule_name!r}; expected one of {SCHEDULE_NAMES}")
+        from ..parallel.schedules import schedule_names
+        if schedule_name not in schedule_names():
+            raise ValueError(f"unknown schedule {schedule_name!r}; expected "
+                             f"one of {schedule_names()}")
     if schedule_name == "Interleaved1F1B" and n_layers % (n_pipe * 2) == 0:
         return 2
     return 1

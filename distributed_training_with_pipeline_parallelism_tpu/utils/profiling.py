@@ -95,7 +95,7 @@ def measure_bubble(cfg, mesh, sched, batch_size: int = 32,
         "t_single_device": t_single,
         "bubble_measured": 1.0 - t_single / (D * t_pipe),
         "bubble_analytic": analytic_bubble_fraction(
-            sched.name, D, sched.n_virtual, sched.n_microbatches),
+            sched.name, D, sched.n_virtual, sched.n_microbatches, cs=cs),
         "bubble_simulated": simulated_bubble(cs, w_f=1.0, w_b=3.0)[
             "bubble_fraction"],
     }

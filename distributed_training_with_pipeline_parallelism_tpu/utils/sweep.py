@@ -75,7 +75,7 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
             "throughput_per_chip": metrics["throughput"] / num_devices,
             "n_virtual": n_virtual,
             "bubble_analytic": analytic_bubble_fraction(
-                schedule_type, num_devices, n_virtual, n_microbatches),
+                schedule_type, num_devices, n_virtual, n_microbatches, cs=cs),
             "bubble_simulated": sim["bubble_fraction"],
         })
         return metrics
