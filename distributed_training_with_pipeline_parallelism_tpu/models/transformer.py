@@ -275,6 +275,11 @@ def transformer_loss(cfg: ModelConfig, params: Dict, tokens: jax.Array,
                      rng: Optional[jax.Array] = None) -> jax.Array:
     """Single-device reference loss — the ground truth the pipeline executors
     are verified against (a check the reference itself never performs,
-    SURVEY.md §4)."""
-    return select_xent(cfg.use_fused_xent)(
-        transformer_apply(cfg, params, tokens, rng=rng), targets)
+    SURVEY.md §4). With ``cfg.pad_token_id`` set, pad targets are ignored
+    and the mean divides by the valid count."""
+    logits = transformer_apply(cfg, params, tokens, rng=rng)
+    if cfg.pad_token_id is not None:
+        from ..ops.layers import masked_xent_sum
+        s, n = masked_xent_sum(logits, targets, cfg.pad_token_id)
+        return s / jnp.maximum(n, 1)
+    return select_xent(cfg.use_fused_xent)(logits, targets)

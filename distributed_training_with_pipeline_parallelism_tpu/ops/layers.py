@@ -9,7 +9,7 @@ bit-level parity against torch is established in tests by copying weights).
 from __future__ import annotations
 
 import math
-from typing import Dict
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -73,15 +73,52 @@ def dropout_apply(x: jax.Array, rate: float, rng) -> jax.Array:
     return jnp.where(keep, x / (1.0 - rate), jnp.zeros((), x.dtype))
 
 
+def _token_nll(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Per-position NLL (fp32 log-softmax), the core shared by the masked
+    and unmasked loss paths so they cannot diverge."""
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logz, targets[..., None], axis=-1)[..., 0]
+
+
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """Mean token-wise cross entropy over all positions.
 
     Matches the reference's ``tokenwise_loss_fn`` — ``nn.CrossEntropyLoss`` over
     flattened ``(B*S, V)`` logits (``LLMsDistributedTrainingHelper.py:197-201``).
     """
-    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logz, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return jnp.mean(_token_nll(logits, targets))
+
+
+def masked_xent_sum(logits: jax.Array, targets: jax.Array,
+                    pad_id: int) -> Tuple[jax.Array, jax.Array]:
+    """Cross-entropy SUM over non-pad positions plus the valid-token count.
+
+    The building block for ignore-index losses (torch's
+    ``CrossEntropyLoss(ignore_index=...)``): the caller divides the summed
+    NLL by the (possibly globally reduced) count, so microbatched/sharded
+    runs can normalize by the GLOBAL valid count instead of a per-chunk
+    mean-of-means (which would weight short sequences more).
+    """
+    nll = _token_nll(logits, targets)
+    valid = targets != pad_id
+    return jnp.sum(jnp.where(valid, nll, 0.0)), jnp.sum(valid)
+
+
+def global_pad_scale(targets: jax.Array, pad_id: int, n_micro: int,
+                     data_axis=None) -> jax.Array:
+    """The factor that turns per-microbatch masked NLL sums into the
+    globally normalized ignore-index mean under the pipeline executor's
+    standard reductions: the executor later multiplies accumulated loss by
+    ``1/n_micro`` and means over ``data_axis`` replicas, so pre-multiplying
+    each sum by ``n_micro * n_data / n_valid_global`` cancels both into
+    ``total_nll / global_valid_count``. Must be called OUTSIDE the schedule
+    scan (it psums over ``data_axis`` when given)."""
+    n_valid = jnp.sum(targets != pad_id).astype(jnp.float32)
+    n_data = 1
+    if data_axis is not None:
+        n_valid = jax.lax.psum(n_valid, data_axis)
+        n_data = jax.lax.axis_size(data_axis)
+    return n_micro * n_data / jnp.maximum(n_valid, 1.0)
 
 
 def select_xent(use_fused: bool):

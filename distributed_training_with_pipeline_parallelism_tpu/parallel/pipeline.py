@@ -47,7 +47,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.transformer import (body_apply, compute_cast, embed_apply,
                                   head_apply, head_norm_apply,
                                   transformer_loss)
-from ..ops.layers import linear_apply, select_xent
+from ..ops.layers import (global_pad_scale, linear_apply, masked_xent_sum,
+                          select_xent)
 from ..utils.config import ModelConfig, ScheduleConfig
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS,
                    SEQ_AXIS)
@@ -186,6 +187,13 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         raise NotImplementedError(
             "dropout currently composes with dense data x pipe meshes; "
             "model/seq/expert axes would need axis-aware mask folding")
+    if cfg.pad_token_id is not None and (
+            moe is not None or n_seq > 1 or n_ep > 1 or tp_vocab_parallel):
+        raise NotImplementedError(
+            "pad_token_id loss masking composes with data x pipe x model "
+            "meshes (replicated-logits loss); seq/expert sharding and the "
+            "vocab-parallel CE would need masked variants of their "
+            "reductions")
     if moe is not None:
         if T > 1 or n_seq > 1:
             raise NotImplementedError(
@@ -311,6 +319,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         aux_scale = (moe.aux_loss_weight / cfg.n_layers / loss_norm
                      if moe is not None else 0.0)
 
+        if cfg.pad_token_id is not None:
+            pad_scale = global_pad_scale(
+                targets, cfg.pad_token_id, M,
+                data_axis=DATA_AXIS if n_data > 1 else None)
+
         def stage_objective(p_v, head_p, x_in, vv, mm, last_stage, g_in):
             """-> (objective, loss_report). The objective's gradients are the
             stage VJP: the real loss through the head on the last stage, else
@@ -332,6 +345,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                                                 tp_copy(yn, tp_axis))
                     local = vocab_parallel_xent(logits_local, targets_mb[mm],
                                                 tp_axis)
+                elif cfg.pad_token_id is not None:
+                    s, _ = masked_xent_sum(head_apply(cfg, head_p, y),
+                                           targets_mb[mm], cfg.pad_token_id)
+                    local = s * pad_scale
                 else:
                     local = select_xent(cfg.use_fused_xent)(
                         head_apply(cfg, head_p, y), targets_mb[mm])
@@ -683,6 +700,18 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         tokens_mb = tokens.reshape(M, mb, seq)
         targets_mb = targets.reshape(M, mb, seq)
 
+        if cfg.pad_token_id is not None:
+            # global-valid-count normalization (see make_pipeline_grad_fn)
+            pad_scale = global_pad_scale(
+                targets, cfg.pad_token_id, M,
+                data_axis=DATA_AXIS if n_data > 1 else None)
+
+        def mb_loss(logits, tgt):
+            if cfg.pad_token_id is not None:
+                s, _ = masked_xent_sum(logits, tgt, cfg.pad_token_id)
+                return s * pad_scale
+            return xent(logits, tgt)
+
         def tick(carry, t):
             recv, loss_acc = carry
             m = t - d  # fill-drain: device d runs microbatch t-d at tick t
@@ -702,7 +731,7 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             is_last = d == D - 1
             loss_mb = jax.lax.cond(
                 active & is_last,
-                lambda: xent(head_apply(cfg, head, y), targets_mb[mm]),
+                lambda: mb_loss(head_apply(cfg, head, y), targets_mb[mm]),
                 lambda: jnp.zeros((), jnp.float32))
             return (jax.lax.ppermute(y, PIPE_AXIS, fwd_perm),
                     loss_acc + loss_mb), None

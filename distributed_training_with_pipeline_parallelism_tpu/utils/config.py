@@ -51,6 +51,12 @@ class ModelConfig:
     # moments stay fp32 (the cast sits inside autodiff, so grads come back
     # fp32 automatically).
     param_dtype: Optional[str] = None
+    # Ignore-index loss masking: target positions equal to this id contribute
+    # nothing to the loss, and the mean divides by the GLOBAL valid-token
+    # count (torch CrossEntropyLoss(ignore_index=...) semantics) — for
+    # right-padded batches of ragged sequences. None = every position counts
+    # (the reference's regime).
+    pad_token_id: Optional[int] = None
     use_flash_attention: bool = False  # route attention through the Pallas kernel
     use_fused_xent: bool = False  # route the loss through the Pallas fused-CE kernel
     remat_layers: bool = False  # jax.checkpoint each layer: trade FLOPs for HBM
@@ -79,6 +85,12 @@ class ModelConfig:
             if self.sliding_window < 1:
                 raise ValueError(f"sliding_window={self.sliding_window} must "
                                  f"be >= 1")
+        if self.pad_token_id is not None and self.use_fused_xent:
+            raise ValueError(
+                "pad_token_id composes with the XLA loss path only: the "
+                "Pallas fused-CE kernel does not implement ignore-index "
+                "masking (silently counting pad positions would change the "
+                "loss normalization)")
         if not 0.0 <= self.dropout < 1.0:
             raise ValueError(f"dropout={self.dropout} must be in [0, 1)")
         if self.dropout > 0.0 and self.use_flash_attention:
@@ -139,13 +151,7 @@ class ScheduleConfig:
     n_virtual: int = 1
 
     def __post_init__(self):
-        if self.name not in SCHEDULE_NAMES:
-            # custom schedules registered via parallel.schedules.register_schedule
-            from ..parallel.schedules import schedule_names
-            if self.name not in schedule_names():
-                raise ValueError(
-                    f"unknown schedule {self.name!r}; expected one of "
-                    f"{schedule_names()}")
+        _check_schedule_name(self.name)
 
 
 # The single source of builtin names is the schedule module; re-exported here
@@ -153,15 +159,19 @@ class ScheduleConfig:
 from ..parallel.schedules import BUILTIN_SCHEDULE_NAMES as SCHEDULE_NAMES  # noqa: E402
 
 
+def _check_schedule_name(name: str) -> None:
+    """Builtin or registered-custom, else ValueError listing every option."""
+    from ..parallel.schedules import schedule_names
+    if name not in schedule_names():
+        raise ValueError(f"unknown schedule {name!r}; expected one of "
+                         f"{schedule_names()}")
+
+
 def virtual_stages_for(schedule_name: str, n_layers: int, n_pipe: int) -> int:
     """Reference rule for stages-per-worker (``LLMsDistributedTrainingHelper.py:181-185``).
     Custom registered schedules get 1 (the rule only special-cases
     Interleaved)."""
-    if schedule_name not in SCHEDULE_NAMES:
-        from ..parallel.schedules import schedule_names
-        if schedule_name not in schedule_names():
-            raise ValueError(f"unknown schedule {schedule_name!r}; expected "
-                             f"one of {schedule_names()}")
+    _check_schedule_name(schedule_name)
     if schedule_name == "Interleaved1F1B" and n_layers % (n_pipe * 2) == 0:
         return 2
     return 1

@@ -89,6 +89,10 @@ def main():
     ap.add_argument("--vocab", type=int, default=0,
                     help="override vocab size (e.g. 256 for byte-level "
                          "corpora from encode_text_file)")
+    ap.add_argument("--pad-id", type=int, default=-1,
+                    help="ignore-index: target positions with this id are "
+                         "excluded from the loss (right-padded batches); "
+                         "-1 disables")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--data-file", default="",
                     help="flat binary token file (uint16 ids); default is "
@@ -165,6 +169,8 @@ def main():
         n_heads=args.heads, vocab_size=args.vocab,
     ).items() if v}
     overrides["dtype"] = args.dtype
+    if args.pad_id >= 0:
+        overrides["pad_token_id"] = args.pad_id
     if args.param_dtype:
         overrides["param_dtype"] = args.param_dtype
     if args.dropout:

@@ -1,0 +1,103 @@
+"""Ignore-index loss masking (pad_token_id): torch CrossEntropyLoss
+ignore_index semantics across the single-device, pipeline, DP, and eval
+paths. The reference has no padding concept (random fixed-length tokens,
+SURVEY.md C5); these contracts are ours.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.models import transformer as tfm
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import make_mesh
+from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+    make_pipeline_loss_fn, make_pipeline_step)
+
+PAD = 0
+CFG = dtpp.ModelConfig(dim=32, n_layers=8, n_heads=4, vocab_size=50,
+                       ffn_dim=64, pad_token_id=PAD)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    params = tfm.transformer_init(jax.random.key(0), CFG)
+    tokens = jax.random.randint(jax.random.key(1), (8, 6), 1, CFG.vocab_size)
+    targets = np.array(
+        jax.random.randint(jax.random.key(2), (8, 6), 1, CFG.vocab_size))
+    # ragged right-padding: row i keeps 2..6 valid targets (uneven on
+    # purpose, including across what will become DP shards)
+    for i, keep in enumerate([2, 6, 3, 5, 4, 6, 2, 5]):
+        targets[i, keep:] = PAD
+    return params, tokens, jnp.asarray(targets)
+
+
+def test_masked_loss_matches_torch_semantics(problem):
+    params, tokens, targets = problem
+    loss = tfm.transformer_loss(CFG, params, tokens, targets)
+    # manual: mean NLL over valid positions only
+    logits = tfm.transformer_apply(CFG, params, tokens)
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logz, targets[..., None], axis=-1)[..., 0]
+    valid = targets != PAD
+    manual = jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.sum(valid)
+    assert float(jnp.abs(loss - manual)) < 1e-6
+    torch = pytest.importorskip("torch")
+    t_loss = torch.nn.functional.cross_entropy(
+        torch.from_numpy(np.asarray(logits, np.float32)).reshape(-1, 50),
+        torch.from_numpy(np.asarray(targets)).reshape(-1).long(),
+        ignore_index=PAD)
+    assert abs(float(loss) - float(t_loss)) < 1e-5
+
+
+@pytest.mark.parametrize("name,D,n_data,V,M", [
+    ("GPipe", 2, 1, 1, 4),
+    ("1F1B", 4, 1, 1, 4),
+    ("Interleaved1F1B", 2, 1, 2, 4),
+    ("ZBH1", 2, 1, 1, 4),
+    ("1F1B", 2, 2, 1, 2),  # DP with UNEVEN valid counts across shards
+])
+def test_pipeline_masked_matches_single_device(problem, name, D, n_data, V, M):
+    params, tokens, targets = problem
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(CFG, p, tokens, targets))(params)
+    step = make_pipeline_step(
+        CFG, make_mesh(n_pipe=D, n_data=n_data),
+        dtpp.ScheduleConfig(name=name, n_microbatches=M, n_virtual=V))
+    loss, grads = step(params, tokens, targets)
+    assert float(jnp.abs(loss - ref_loss)) < 1e-5
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                       grads, ref_grads)
+    assert max(jax.tree.leaves(err)) < 1e-5
+
+
+def test_eval_loss_masked(problem):
+    params, tokens, targets = problem
+    ref = float(tfm.transformer_loss(CFG, params, tokens, targets))
+    for n_data in (1, 2):
+        loss_fn = make_pipeline_loss_fn(
+            CFG, make_mesh(n_pipe=2, n_data=n_data),
+            dtpp.ScheduleConfig(name="GPipe", n_microbatches=2))
+        assert abs(float(loss_fn(params, tokens, targets)) - ref) < 1e-5
+
+
+def test_all_pad_microbatch_is_finite(problem):
+    # a microbatch whose targets are ALL pad must not produce NaN/inf
+    params, tokens, _ = problem
+    targets = jnp.full((8, 6), PAD, dtype=jnp.int32)
+    step = make_pipeline_step(
+        CFG, make_mesh(n_pipe=2),
+        dtpp.ScheduleConfig(name="GPipe", n_microbatches=4))
+    loss, grads = step(params, tokens, targets)
+    assert float(loss) == 0.0
+    assert all(bool(jnp.all(jnp.isfinite(g))) for g in jax.tree.leaves(grads))
+
+
+def test_pad_guards():
+    with pytest.raises(ValueError, match="fused"):
+        dtpp.ModelConfig(pad_token_id=0, use_fused_xent=True)
+    with pytest.raises(NotImplementedError):
+        make_pipeline_step(CFG, make_mesh(n_pipe=2, n_seq=2),
+                           dtpp.ScheduleConfig(name="GPipe", n_microbatches=2))
