@@ -127,7 +127,8 @@ def _forward_with_cache(cfg: ModelConfig, params: Pytree, cache: Pytree,
 
     h, (k_new, v_new) = jax.lax.scan(body, h,
                                      (params["layers"], cache["k"], cache["v"]))
-    logits = head_apply(cfg, params["head"], h[:, -1:])[:, 0]
+    logits = head_apply(cfg, params["head"], h[:, -1:],
+                        embed=params["embed"])[:, 0]
     return logits, {"k": k_new, "v": v_new}
 
 

@@ -18,9 +18,10 @@ Convention notes (why the conversion is exact, verified to ~1e-4 in
   default; LayerNorm eps 1e-5 matches :func:`..ops.layers.layer_norm_apply`.
 - HF Llama RoPE is the half-split ("rotate_half") convention — identical to
   :func:`..ops.attention.apply_rope`; rms eps is carried through the config.
-- GPT-2 ties ``lm_head`` to ``wte``; the tied matrix is materialized as
-  ``head.out.w`` (this framework keeps an explicit output head so stage
-  slicing stays uniform, SURVEY.md C3).
+- ``tie_word_embeddings`` carries through as ``cfg.tie_embeddings``: a tied
+  HF checkpoint (GPT-2's default, Llama-3.2-class) imports as a tied config
+  with no separate head matrix; untied checkpoints materialize
+  ``head.out.w``.
 """
 
 from __future__ import annotations
@@ -63,7 +64,8 @@ def gpt2_config_from_hf(hf_config) -> ModelConfig:
         dim=hf_config.n_embd, n_layers=hf_config.n_layer,
         n_heads=hf_config.n_head, vocab_size=hf_config.vocab_size,
         ffn_dim=hf_config.n_inner or 4 * hf_config.n_embd,
-        max_seq_len=hf_config.n_positions, arch="gpt2")
+        max_seq_len=hf_config.n_positions, arch="gpt2",
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", True)))
 
 
 def gpt2_params_from_hf(model_or_sd, cfg: ModelConfig) -> Pytree:
@@ -89,12 +91,15 @@ def gpt2_params_from_hf(model_or_sd, cfg: ModelConfig) -> Pytree:
         }
 
     wte = sd[pre + "wte.weight"]
+    head = {"norm": {"scale": sd[pre + "ln_f.weight"],
+                     "bias": sd[pre + "ln_f.bias"]}}
+    if not cfg.tie_embeddings:
+        # untied config: materialize the head matrix explicitly
+        head["out"] = {"w": sd.get("lm_head.weight", wte).T}
     params = {
         "embed": {"tok": wte, "pos": sd[pre + "wpe.weight"][:cfg.max_seq_len]},
         "layers": _stack([layer(i) for i in range(cfg.n_layers)]),
-        "head": {"norm": {"scale": sd[pre + "ln_f.weight"],
-                          "bias": sd[pre + "ln_f.bias"]},
-                 "out": {"w": sd.get("lm_head.weight", wte).T}},  # tied head
+        "head": head,
     }
     return _to_dtype(params, cfg)
 
@@ -145,7 +150,8 @@ def llama_config_from_hf(hf_config) -> ModelConfig:
         rope_theta=float(hf_config.rope_theta),
         rope_scaling=rope_scaling,
         sliding_window=getattr(hf_config, "sliding_window", None),
-        rms_eps=float(hf_config.rms_norm_eps))
+        rms_eps=float(hf_config.rms_norm_eps),
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)))
 
 
 def llama_params_from_hf(model_or_sd, cfg: ModelConfig) -> Pytree:
@@ -170,12 +176,14 @@ def llama_params_from_hf(model_or_sd, cfg: ModelConfig) -> Pytree:
         }
 
     embed = sd[pre + "embed_tokens.weight"]
+    head = {"norm": {"scale": sd[pre + "norm.weight"]}}
+    if not cfg.tie_embeddings:
+        head["out"] = {"w": sd["lm_head.weight"].T if "lm_head.weight" in sd
+                       else embed.T}  # materialize a tied source untied
     params = {
         "embed": {"tok": embed},
         "layers": _stack([layer(i) for i in range(cfg.n_layers)]),
-        "head": {"norm": {"scale": sd[pre + "norm.weight"]},
-                 "out": {"w": sd["lm_head.weight"].T if "lm_head.weight" in sd
-                         else embed.T}},  # tied head (llama3.2-class)
+        "head": head,
     }
     return _to_dtype(params, cfg)
 
@@ -238,8 +246,9 @@ def gpt2_state_dict(cfg: ModelConfig, params: Pytree) -> Dict[str, np.ndarray]:
         "transformer.wpe.weight": _f32(params["embed"]["pos"]),
         "transformer.ln_f.weight": _f32(params["head"]["norm"]["scale"]),
         "transformer.ln_f.bias": _f32(params["head"]["norm"]["bias"]),
-        "lm_head.weight": _f32(params["head"]["out"]["w"]).T,
     }
+    if not cfg.tie_embeddings:
+        sd["lm_head.weight"] = _f32(params["head"]["out"]["w"]).T
     ly = params["layers"]
     for i in range(L):
         p = f"transformer.h.{i}."
@@ -266,8 +275,9 @@ def llama_state_dict(cfg: ModelConfig, params: Pytree) -> Dict[str, np.ndarray]:
     sd: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": _f32(params["embed"]["tok"]),
         "model.norm.weight": _f32(params["head"]["norm"]["scale"]),
-        "lm_head.weight": _f32(params["head"]["out"]["w"]).T,
     }
+    if not cfg.tie_embeddings:
+        sd["lm_head.weight"] = _f32(params["head"]["out"]["w"]).T
     ly = params["layers"]
     for i in range(cfg.n_layers):
         p = f"model.layers.{i}."
@@ -292,10 +302,10 @@ def to_hf(cfg: ModelConfig, params: Pytree):
     framework's (tests/test_hf_export.py). Save with
     ``to_hf(...).save_pretrained(path)``.
 
-    ``tie_word_embeddings=False`` always: this framework trains the output
-    head independently of the token embedding (SURVEY.md C2: the reference's
-    ``Linear(dim, vocab)`` is untied), so a tied HF model could not represent
-    a trained checkpoint.
+    ``tie_word_embeddings`` follows ``cfg.tie_embeddings``: untied configs
+    (the reference-parity default — SURVEY.md C2: ``Linear(dim, vocab)`` is
+    untied) export an explicit ``lm_head``; tied configs export no head
+    matrix and let transformers tie it to ``wte``/``embed_tokens``.
 
     The reference has no export path at all (SURVEY.md §5 checkpoint row);
     this closes the loop with :func:`from_hf` so models pretrained or
@@ -308,7 +318,8 @@ def to_hf(cfg: ModelConfig, params: Pytree):
         hf_cfg = transformers.GPT2Config(
             vocab_size=cfg.vocab_size, n_positions=cfg.max_seq_len,
             n_embd=cfg.dim, n_layer=cfg.n_layers, n_head=cfg.n_heads,
-            n_inner=cfg.ffn_dim, tie_word_embeddings=False)
+            n_inner=cfg.ffn_dim,
+            tie_word_embeddings=cfg.tie_embeddings)
         model = transformers.GPT2LMHeadModel(hf_cfg)
         sd = gpt2_state_dict(cfg, params)
     elif cfg.arch == "llama":
@@ -319,7 +330,7 @@ def to_hf(cfg: ModelConfig, params: Pytree):
             num_key_value_heads=cfg.n_kv_heads or cfg.n_heads,
             max_position_embeddings=cfg.max_seq_len,
             rms_norm_eps=cfg.rms_eps, rope_theta=cfg.rope_theta,
-            tie_word_embeddings=False)
+            tie_word_embeddings=cfg.tie_embeddings)
         if cfg.sliding_window is not None:
             if cfg.rope_scaling is not None:
                 raise NotImplementedError(
@@ -350,10 +361,16 @@ def to_hf(cfg: ModelConfig, params: Pytree):
         missing, unexpected = model.load_state_dict(
             {k: torch.from_numpy(np.array(v)) for k, v in sd.items()},
             strict=False)
-    # rotary inv_freq buffers etc. may be "missing" (they are derived);
-    # a real weight missing or an unknown key is a conversion bug
-    real_missing = [k for k in missing if "inv_freq" not in k]
+    # rotary inv_freq buffers etc. may be "missing" (they are derived), and
+    # a tied config intentionally ships no lm_head (transformers ties it to
+    # the embedding); any other missing weight or unknown key is a
+    # conversion bug
+    real_missing = [k for k in missing
+                    if "inv_freq" not in k
+                    and not (cfg.tie_embeddings and k == "lm_head.weight")]
     if real_missing or unexpected:
         raise RuntimeError(f"export mismatch: missing={real_missing}, "
                            f"unexpected={unexpected}")
+    if cfg.tie_embeddings:
+        model.tie_weights()  # re-point lm_head at the loaded embedding
     return model.eval()

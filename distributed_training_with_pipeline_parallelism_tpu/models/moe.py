@@ -225,6 +225,10 @@ def moe_lm_loss(cfg: ModelConfig, moe: MoEConfig, params: Dict,
     """CE loss + mean per-layer aux loss. Differentiable; works unsharded
     (``axis_name=None``) or inside the EP shard_map (tokens batch-sharded,
     experts sharded — :func:`..parallel.expert_parallel.make_ep_loss_fn`)."""
+    if cfg.pad_token_id is not None:
+        raise NotImplementedError(
+            "pad_token_id masking is not implemented for the MoE loss; "
+            "mirror the pipeline guard rather than silently mis-normalize")
     h = embedding_apply(params["embed"]["tok"], tokens)
     h = h + params["embed"]["pos"][: tokens.shape[1]]
     h = h.astype(jnp.dtype(cfg.dtype))

@@ -170,16 +170,27 @@ def mlp_block(cfg: ModelConfig, params: Dict, h: jax.Array,
 
 def transformer_init(key: jax.Array, cfg: ModelConfig) -> Dict:
     ke, kp, kl, kn, ko = jax.random.split(key, 5)
-    embed: Dict = {"tok": embedding_init(ke, cfg.vocab_size, cfg.dim)}
+    if cfg.arch == "ref_decoder":
+        # torch nn.Embedding parity: N(0, 1) (the reference's init)
+        tok = embedding_init(ke, cfg.vocab_size, cfg.dim)
+    else:
+        # GPT-2/Llama convention: N(0, 0.02) — essential under tied
+        # embeddings, where N(0,1) rows make initial logits ~sqrt(dim) hot
+        tok = 0.02 * jax.random.normal(ke, (cfg.vocab_size, cfg.dim))
+    embed: Dict = {"tok": tok}
     if cfg.arch == "gpt2":
         embed["pos"] = 0.02 * jax.random.normal(kp, (cfg.max_seq_len, cfg.dim))
     layer_keys = jax.random.split(kl, cfg.n_layers)
     layers = jax.vmap(lambda k: layer_init(k, cfg))(layer_keys)
-    if cfg.arch == "llama":
-        head = {"norm": rms_norm_init(cfg.dim),
+    norm = (rms_norm_init(cfg.dim) if cfg.arch == "llama"
+            else layer_norm_init(cfg.dim))
+    if cfg.tie_embeddings:
+        head = {"norm": norm}  # logits come from embed.tok.T
+    elif cfg.arch == "llama":
+        head = {"norm": norm,
                 "out": linear_init(ko, cfg.dim, cfg.vocab_size, bias=False)}
     else:
-        head = {"norm": layer_norm_init(cfg.dim),
+        head = {"norm": norm,
                 "out": linear_init(ko, cfg.dim, cfg.vocab_size, bias=cfg.arch == "ref_decoder")}
     params = {"embed": embed, "layers": layers, "head": head}
     dtype = jnp.dtype(cfg.storage_dtype)  # master-weight dtype under mixing
@@ -251,8 +262,13 @@ def head_norm_apply(cfg: ModelConfig, head: Dict, h: jax.Array) -> jax.Array:
     return layer_norm_apply(head["norm"], h)
 
 
-def head_apply(cfg: ModelConfig, head: Dict, h: jax.Array) -> jax.Array:
-    return linear_apply(head["out"], head_norm_apply(cfg, head, h))
+def head_apply(cfg: ModelConfig, head: Dict, h: jax.Array,
+               embed: Optional[Dict] = None) -> jax.Array:
+    hn = head_norm_apply(cfg, head, h)
+    if cfg.tie_embeddings:
+        assert embed is not None, "tied head needs the embedding table"
+        return hn @ embed["tok"].T
+    return linear_apply(head["out"], hn)
 
 
 def transformer_apply(cfg: ModelConfig, params: Dict, tokens: jax.Array,
@@ -267,7 +283,7 @@ def transformer_apply(cfg: ModelConfig, params: Dict, tokens: jax.Array,
     params = compute_cast(cfg, params)  # bf16 compute over fp32 masters
     h = embed_apply(cfg, params["embed"], tokens, rng=rng_e)
     h = body_apply(cfg, params["layers"], h, rng=rng)
-    return head_apply(cfg, params["head"], h)
+    return head_apply(cfg, params["head"], h, embed=params["embed"])
 
 
 def transformer_loss(cfg: ModelConfig, params: Dict, tokens: jax.Array,
@@ -279,7 +295,8 @@ def transformer_loss(cfg: ModelConfig, params: Dict, tokens: jax.Array,
     and the mean divides by the valid count."""
     logits = transformer_apply(cfg, params, tokens, rng=rng)
     if cfg.pad_token_id is not None:
-        from ..ops.layers import masked_xent_sum
-        s, n = masked_xent_sum(logits, targets, cfg.pad_token_id)
+        from ..ops.layers import select_masked_xent_sum
+        s, n = select_masked_xent_sum(cfg.use_fused_xent)(
+            logits, targets, cfg.pad_token_id)
         return s / jnp.maximum(n, 1)
     return select_xent(cfg.use_fused_xent)(logits, targets)

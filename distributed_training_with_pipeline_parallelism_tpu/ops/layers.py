@@ -121,6 +121,15 @@ def global_pad_scale(targets: jax.Array, pad_id: int, n_micro: int,
     return n_micro * n_data / jnp.maximum(n_valid, 1.0)
 
 
+def select_masked_xent_sum(use_fused: bool):
+    """Pick the ignore-index loss core: the XLA :func:`masked_xent_sum` or
+    its fused-kernel twin. Same (sum, count) contract, identical values."""
+    if use_fused:
+        from .pallas_xent import fused_masked_xent_sum
+        return fused_masked_xent_sum
+    return masked_xent_sum
+
+
 def select_xent(use_fused: bool):
     """Pick the loss implementation: the XLA formulation above, or the Pallas
     fused kernel (``ops.pallas_xent``) which never materializes the [N, V]

@@ -129,3 +129,13 @@ def fused_cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array
     """Drop-in for ``ops.layers.cross_entropy_loss`` (mean token-wise NLL,
     reference ``tokenwise_loss_fn`` semantics) through the fused kernel."""
     return jnp.mean(fused_softmax_xent(logits, targets))
+
+
+def fused_masked_xent_sum(logits: jax.Array, targets: jax.Array, pad_id: int):
+    """Fused twin of ``ops.layers.masked_xent_sum`` (ignore-index): NLL sum
+    over non-pad positions + valid count. Masking happens on the kernel's
+    per-token NLL output, so the custom-vjp backward sees a zero cotangent
+    on pad rows and their logit gradients vanish exactly (tested)."""
+    nll = fused_softmax_xent(logits, targets)
+    valid = targets != pad_id
+    return jnp.sum(jnp.where(valid, nll, 0.0)), jnp.sum(valid)

@@ -47,8 +47,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.transformer import (body_apply, compute_cast, embed_apply,
                                   head_apply, head_norm_apply,
                                   transformer_loss)
-from ..ops.layers import (global_pad_scale, linear_apply, masked_xent_sum,
-                          select_xent)
+from ..ops.layers import (global_pad_scale, linear_apply,
+                          select_masked_xent_sum, select_xent)
 from ..utils.config import ModelConfig, ScheduleConfig
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS,
                    SEQ_AXIS)
@@ -187,6 +187,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         raise NotImplementedError(
             "dropout currently composes with dense data x pipe meshes; "
             "model/seq/expert axes would need axis-aware mask folding")
+    if cfg.tie_embeddings and (moe is not None or tp_vocab_parallel):
+        raise NotImplementedError(
+            "tie_embeddings composes with dense stages and the replicated "
+            "head (MoE keeps its own head; the vocab-parallel CE would "
+            "need an embed-sharded variant)")
     if cfg.pad_token_id is not None and (
             moe is not None or n_seq > 1 or n_ep > 1 or tp_vocab_parallel):
         raise NotImplementedError(
@@ -260,6 +265,9 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         tokens_mb = tokens.reshape(M, mb, seq)
         targets_mb = targets.reshape(M, mb, seq)
         mb_shape = (mb, seq, cfg.dim)
+        # tied embeddings: the head argument of the stage objective bundles
+        # the embedding so the last stage's VJP produces its grad
+        head_bundle = (head, embed) if cfg.tie_embeddings else head
 
         def stage_body(layer_p, x, vv=0, mm=0):
             """-> (y, aux): aux is the stage's summed routing load-balance
@@ -324,15 +332,21 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 targets, cfg.pad_token_id, M,
                 data_axis=DATA_AXIS if n_data > 1 else None)
 
-        def stage_objective(p_v, head_p, x_in, vv, mm, last_stage, g_in):
+        def stage_objective(p_v, head_arg, x_in, vv, mm, last_stage, g_in):
             """-> (objective, loss_report). The objective's gradients are the
             stage VJP: the real loss through the head on the last stage, else
             the contraction of the stage output with the incoming cotangent —
             plus this stage's share of the MoE routing aux loss. loss_report
             is what the tick accumulates into the reported loss. ``(vv, mm)``
             select the dropout stream, so the rematerialized forward here
-            draws exactly the masks the forward unit drew."""
-            head_p = compute_cast(cfg, head_p)
+            draws exactly the masks the forward unit drew. Under tied
+            embeddings ``head_arg`` is ``(head, embed)`` so the embedding
+            receives its head-matmul gradient through this VJP."""
+            head_arg = compute_cast(cfg, head_arg)
+            if cfg.tie_embeddings:
+                head_p, embed_p = head_arg
+            else:
+                head_p, embed_p = head_arg, None
             y, aux = stage_body(p_v, x_in, vv, mm)
 
             def loss_branch():
@@ -346,12 +360,14 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     local = vocab_parallel_xent(logits_local, targets_mb[mm],
                                                 tp_axis)
                 elif cfg.pad_token_id is not None:
-                    s, _ = masked_xent_sum(head_apply(cfg, head_p, y),
-                                           targets_mb[mm], cfg.pad_token_id)
+                    s, _ = select_masked_xent_sum(cfg.use_fused_xent)(
+                        head_apply(cfg, head_p, y, embed=embed_p),
+                        targets_mb[mm], cfg.pad_token_id)
                     local = s * pad_scale
                 else:
                     local = select_xent(cfg.use_fused_xent)(
-                        head_apply(cfg, head_p, y), targets_mb[mm])
+                        head_apply(cfg, head_p, y, embed=embed_p),
+                        targets_mb[mm])
                 return local / loss_norm
 
             main = jax.lax.cond(
@@ -419,7 +435,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     g_in = grad_buf[jnp.maximum(row[COL_BWD_GSLOT], 0)]
                     params_v = select_v(layers_local, vv)
                     (_, report), gx = jax.value_and_grad(
-                        lambda x_in: stage_objective(params_v, head, x_in, vv,
+                        lambda x_in: stage_objective(params_v, head_bundle, x_in, vv,
                                                      mm, last_stage, g_in),
                         has_aux=True)(x)
                     return loss_acc + report, gx
@@ -443,7 +459,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     (gp, gh, gx), _ = jax.grad(
                         lambda p_v, head_p, x_in: stage_objective(
                             p_v, head_p, x_in, vv, mm, last_stage, g_in),
-                        argnums=(0, 1, 2), has_aux=True)(params_v, head, x_slot)
+                        argnums=(0, 1, 2), has_aux=True)(params_v, head_bundle, x_slot)
                     g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
                                             g_layers, gp)
                     g_head = jax.tree.map(jnp.add, g_head, gh)
@@ -479,7 +495,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 (_, report), (gp, gh, gx) = jax.value_and_grad(
                     lambda p_v, head_p, x_in: stage_objective(
                         p_v, head_p, x_in, vv, mm, last_stage, g_in),
-                    argnums=(0, 1, 2), has_aux=True)(params_v, head, x)
+                    argnums=(0, 1, 2), has_aux=True)(params_v, head_bundle, x)
 
                 g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
                                         g_layers, gp)
@@ -516,11 +532,16 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             jnp.zeros(mb_shape, dtype),
             jax.tree.map(jnp.zeros_like, layers_local),
             jax.tree.map(jnp.zeros_like, embed),
-            jax.tree.map(jnp.zeros_like, head),
+            jax.tree.map(jnp.zeros_like, head_bundle),
             jnp.zeros((), jnp.float32),
         )
         carry, _ = jax.lax.scan(tick, carry0, table)
         (_, _, _, _, g_layers, g_embed, g_head, loss_acc) = carry
+        if cfg.tie_embeddings:
+            # merge the head-matmul embedding grads (last stage) into the
+            # lookup grads (first stage) BEFORE the shared reductions below
+            g_head, g_embed_tied = g_head
+            g_embed = jax.tree.map(jnp.add, g_embed, g_embed_tied)
 
         # Reductions: loss lives on the last stage only; embed/head grads on
         # one device each — psum replicates them across 'pipe'. Scale by 1/M
@@ -708,7 +729,8 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
         def mb_loss(logits, tgt):
             if cfg.pad_token_id is not None:
-                s, _ = masked_xent_sum(logits, tgt, cfg.pad_token_id)
+                s, _ = select_masked_xent_sum(cfg.use_fused_xent)(
+                    logits, tgt, cfg.pad_token_id)
                 return s * pad_scale
             return xent(logits, tgt)
 
@@ -731,7 +753,8 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             is_last = d == D - 1
             loss_mb = jax.lax.cond(
                 active & is_last,
-                lambda: mb_loss(head_apply(cfg, head, y), targets_mb[mm]),
+                lambda: mb_loss(head_apply(cfg, head, y, embed=embed),
+                                targets_mb[mm]),
                 lambda: jnp.zeros((), jnp.float32))
             return (jax.lax.ppermute(y, PIPE_AXIS, fwd_perm),
                     loss_acc + loss_mb), None
@@ -823,7 +846,8 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             is_last = d == D - 1
             logits_mb = jax.lax.cond(
                 active & is_last,
-                lambda: head_apply(cfg, head, y).astype(jnp.float32),
+                lambda: head_apply(cfg, head, y,
+                                   embed=embed).astype(jnp.float32),
                 lambda: jnp.zeros((mb, seq, cfg.vocab_size), jnp.float32))
             out = out.at[mm].set(jnp.where(active & is_last, logits_mb,
                                            out[mm]))

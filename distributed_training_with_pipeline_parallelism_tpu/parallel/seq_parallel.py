@@ -134,6 +134,16 @@ def make_sp_loss_fn(cfg: ModelConfig, mesh: Mesh, attn_impl: str = "ring",
     if attn_impl not in ATTN_IMPLS:
         raise ValueError(f"attn_impl must be one of {sorted(ATTN_IMPLS)}, "
                          f"got {attn_impl!r}")
+    if cfg.pad_token_id is not None:
+        raise NotImplementedError(
+            "pad_token_id masking is not implemented for the seq-parallel "
+            "loss (its per-shard mean assumes every position counts); "
+            "mirror the pipeline guard rather than silently mis-normalize")
+    if cfg.tie_embeddings:
+        raise NotImplementedError(
+            "tie_embeddings is not implemented for the seq-parallel loss "
+            "(the tied head needs the embedding threaded into the "
+            "last-stage objective)")
     D = mesh.shape[SEQ_AXIS]
 
     def spmd_loss(params, tokens, targets):

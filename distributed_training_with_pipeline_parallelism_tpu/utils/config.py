@@ -51,6 +51,12 @@ class ModelConfig:
     # moments stay fp32 (the cast sits inside autodiff, so grads come back
     # fp32 automatically).
     param_dtype: Optional[str] = None
+    # Tie the output head to the token embedding (GPT-2 upstream,
+    # Llama-3.2-class): the head has no "out" matrix; logits are
+    # ``norm(h) @ embed.tok.T`` and the embedding receives gradient from
+    # both its lookup and the head matmul. The reference's Linear head is
+    # untied (SURVEY.md C2), so False is the parity default.
+    tie_embeddings: bool = False
     # Ignore-index loss masking: target positions equal to this id contribute
     # nothing to the loss, and the mean divides by the GLOBAL valid-token
     # count (torch CrossEntropyLoss(ignore_index=...) semantics) — for
@@ -85,12 +91,6 @@ class ModelConfig:
             if self.sliding_window < 1:
                 raise ValueError(f"sliding_window={self.sliding_window} must "
                                  f"be >= 1")
-        if self.pad_token_id is not None and self.use_fused_xent:
-            raise ValueError(
-                "pad_token_id composes with the XLA loss path only: the "
-                "Pallas fused-CE kernel does not implement ignore-index "
-                "masking (silently counting pad positions would change the "
-                "loss normalization)")
         if not 0.0 <= self.dropout < 1.0:
             raise ValueError(f"dropout={self.dropout} must be in [0, 1)")
         if self.dropout > 0.0 and self.use_flash_attention:

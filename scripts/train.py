@@ -89,6 +89,9 @@ def main():
     ap.add_argument("--vocab", type=int, default=0,
                     help="override vocab size (e.g. 256 for byte-level "
                          "corpora from encode_text_file)")
+    ap.add_argument("--tie-embeddings", action="store_true",
+                    help="tie the output head to the token embedding "
+                         "(GPT-2-upstream / Llama-3.2 style)")
     ap.add_argument("--pad-id", type=int, default=-1,
                     help="ignore-index: target positions with this id are "
                          "excluded from the loss (right-padded batches); "
@@ -171,6 +174,8 @@ def main():
     overrides["dtype"] = args.dtype
     if args.pad_id >= 0:
         overrides["pad_token_id"] = args.pad_id
+    if args.tie_embeddings:
+        overrides["tie_embeddings"] = True
     if args.param_dtype:
         overrides["param_dtype"] = args.param_dtype
     if args.dropout:
