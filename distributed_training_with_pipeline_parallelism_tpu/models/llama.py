@@ -31,6 +31,15 @@ def llama_config(name: str = "llama2-7b", **overrides) -> ModelConfig:
         "mistral-7b-v0.3": dict(dim=4096, n_layers=32, n_heads=32,
                                 n_kv_heads=8, ffn_dim=14336, vocab_size=32768,
                                 rope_theta=1e6, max_seq_len=32768),
+        # 3.2 small models: llama3 blocks, TIED embeddings, rope scaling
+        "llama3.2-1b": dict(dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+                            ffn_dim=8192, vocab_size=128256, rope_theta=5e5,
+                            max_seq_len=131072, tie_embeddings=True,
+                            rope_scaling=(32.0, 1.0, 4.0, 8192)),
+        "llama3.2-3b": dict(dim=3072, n_layers=28, n_heads=24, n_kv_heads=8,
+                            ffn_dim=8192, vocab_size=128256, rope_theta=5e5,
+                            max_seq_len=131072, tie_embeddings=True,
+                            rope_scaling=(32.0, 1.0, 4.0, 8192)),
         # scaled-down variant with the same shape ratios for tests/benches
         "llama-debug": dict(dim=256, n_layers=8, n_heads=8, n_kv_heads=4,
                             ffn_dim=688, vocab_size=1024, rope_theta=1e4),

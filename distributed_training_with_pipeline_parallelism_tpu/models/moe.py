@@ -229,6 +229,11 @@ def moe_lm_loss(cfg: ModelConfig, moe: MoEConfig, params: Dict,
         raise NotImplementedError(
             "pad_token_id masking is not implemented for the MoE loss; "
             "mirror the pipeline guard rather than silently mis-normalize")
+    if cfg.tie_embeddings:
+        raise NotImplementedError(
+            "tie_embeddings is not implemented for MoE models (moe_lm_init "
+            "builds its own untied head); silently training untied would "
+            "ignore the requested weight sharing")
     h = embedding_apply(params["embed"]["tok"], tokens)
     h = h + params["embed"]["pos"][: tokens.shape[1]]
     h = h.astype(jnp.dtype(cfg.dtype))

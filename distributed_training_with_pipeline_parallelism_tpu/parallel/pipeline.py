@@ -460,6 +460,12 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                         lambda p_v, head_p, x_in: stage_objective(
                             p_v, head_p, x_in, vv, mm, last_stage, g_in),
                         argnums=(0, 1, 2), has_aux=True)(params_v, head_bundle, x_slot)
+                    if cfg.tie_embeddings:
+                        # fold the tied head's embed grad into the ONE
+                        # g_embed accumulator (a bundle-shaped g_head carry
+                        # would duplicate the [vocab, dim] buffer per device)
+                        gh, gh_embed = gh
+                        g_embed = jax.tree.map(jnp.add, g_embed, gh_embed)
                     g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
                                             g_layers, gp)
                     g_head = jax.tree.map(jnp.add, g_head, gh)
@@ -497,6 +503,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                         p_v, head_p, x_in, vv, mm, last_stage, g_in),
                     argnums=(0, 1, 2), has_aux=True)(params_v, head_bundle, x)
 
+                if cfg.tie_embeddings:
+                    # fold the tied head's embed grad into the ONE g_embed
+                    # accumulator (see wgrad_unit note)
+                    gh, gh_embed = gh
+                    g_embed = jax.tree.map(jnp.add, g_embed, gh_embed)
                 g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
                                         g_layers, gp)
                 g_head = jax.tree.map(jnp.add, g_head, gh)
@@ -532,16 +543,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             jnp.zeros(mb_shape, dtype),
             jax.tree.map(jnp.zeros_like, layers_local),
             jax.tree.map(jnp.zeros_like, embed),
-            jax.tree.map(jnp.zeros_like, head_bundle),
+            jax.tree.map(jnp.zeros_like, head),
             jnp.zeros((), jnp.float32),
         )
         carry, _ = jax.lax.scan(tick, carry0, table)
         (_, _, _, _, g_layers, g_embed, g_head, loss_acc) = carry
-        if cfg.tie_embeddings:
-            # merge the head-matmul embedding grads (last stage) into the
-            # lookup grads (first stage) BEFORE the shared reductions below
-            g_head, g_embed_tied = g_head
-            g_embed = jax.tree.map(jnp.add, g_embed, g_embed_tied)
 
         # Reductions: loss lives on the last stage only; embed/head grads on
         # one device each — psum replicates them across 'pipe'. Scale by 1/M

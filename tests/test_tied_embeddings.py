@@ -105,6 +105,25 @@ def test_tied_hf_export_round_trip():
     assert np.allclose(ours, theirs, atol=2e-4), np.abs(ours - theirs).max()
 
 
+def test_llama32_registry_configs():
+    from distributed_training_with_pipeline_parallelism_tpu.models.llama import (
+        llama_config)
+
+    for name, dim, layers in [("llama3.2-1b", 2048, 16),
+                              ("llama3.2-3b", 3072, 28)]:
+        cfg = llama_config(name)
+        assert (cfg.dim, cfg.n_layers) == (dim, layers)
+        assert cfg.tie_embeddings and cfg.rope_scaling is not None
+    # a scaled-down tied llama builds, runs, and has no head matrix
+    tiny = llama_config("llama3.2-1b", dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, ffn_dim=128, vocab_size=128,
+                        max_seq_len=32)
+    params = tfm.transformer_init(jax.random.key(0), tiny)
+    assert "out" not in params["head"]
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, 128)
+    assert jnp.isfinite(tfm.transformer_loss(tiny, params, tokens, tokens))
+
+
 def test_tied_trains():
     from distributed_training_with_pipeline_parallelism_tpu.utils.train import (
         fit, synthetic_data)
