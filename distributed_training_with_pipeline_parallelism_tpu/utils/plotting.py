@@ -22,7 +22,8 @@ def _mpl():
     return plt
 
 SCHEDULE_COLORS = {"GPipe": "tab:blue", "1F1B": "tab:orange",
-                   "Interleaved1F1B": "tab:green"}
+                   "Interleaved1F1B": "tab:green",
+                   "ZBH1": "tab:red", "BFS": "tab:purple"}
 PROC_MARKERS = {2: "o", 4: "s", 8: "^", 16: "D"}
 
 

@@ -128,10 +128,10 @@ def compute_speedup_and_efficiency(df: pd.DataFrame) -> pd.DataFrame:
         if gp.empty:
             continue
         base = float(gp["throughput"].iloc[0])
-        for schedule in ("1F1B", "Interleaved1F1B"):
+        # every non-GPipe schedule present (the reference's two, plus any
+        # beyond-parity/custom schedules the sweep was run with)
+        for schedule in [s for s in g["schedule"].unique() if s != "GPipe"]:
             row = g[g["schedule"] == schedule]
-            if row.empty:
-                continue
             speedup = float(row["throughput"].iloc[0]) / base
             rows.append({
                 "n_layers": L, "n_heads": H, "num_processes": D,

@@ -30,6 +30,10 @@ def main():
                          "--quick; smaller widths keep full sweeps tractable "
                          "on simulated CPU meshes)")
     ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--schedules", nargs="+", default=None,
+                    help="override the schedule list, e.g. "
+                         "--schedules GPipe 1F1B ZBH1 BFS (default: the "
+                         "reference's three; ZBH1/BFS are beyond-parity)")
     args = ap.parse_args()
 
     if args.simulate_devices:
@@ -44,6 +48,12 @@ def main():
 
     dim = args.dim or (64 if args.quick else 768)
     kwargs = dict(dim=dim, dtype=args.dtype)
+    if args.schedules:
+        if "GPipe" not in args.schedules:
+            print("note: GPipe not in --schedules; speedup/efficiency "
+                  "tables need it as the baseline and will be empty",
+                  flush=True)
+        kwargs["schedules"] = tuple(args.schedules)
     if args.quick:
         kwargs.update(layers=(4,), heads=(4, 8), devices=(2,),
                       batch_size=8, seq_length=32, vocab_size=256)
