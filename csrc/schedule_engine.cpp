@@ -188,7 +188,10 @@ SlotAlloc allocate(std::vector<std::tuple<int, int, std::pair<int, int>>> events
   return out;
 }
 
-// Tick-table column layout (schedules.py).
+// Tick-table column layout (schedules.py). Columns 13-16 are the vshape
+// (ZB-V) reverse/local transfer routes; the wrap-placement schedules this
+// engine compiles never use them, so they stay -1 — keeping wrap tables
+// bit-identical to the Python compiler's.
 enum Cols {
   COL_STORE_F_SLOT = 0,
   COL_FWD_V = 1, COL_FWD_M = 2, COL_FWD_SLOT = 3,
@@ -197,7 +200,9 @@ enum Cols {
   COL_BWD_ASLOT = 7, COL_BWD_GSLOT = 8,
   COL_W_V = 9, COL_W_M = 10,
   COL_W_ASLOT = 11, COL_W_GSLOT = 12,
-  N_COLS = 13,
+  COL_FWD_LOCAL_SLOT = 13, COL_STORE_F_NEG_SLOT = 14,
+  COL_BWD_LOCAL_SLOT = 15, COL_STORE_B_POS_SLOT = 16,
+  N_COLS = 17,
 };
 
 }  // namespace

@@ -52,8 +52,10 @@ from ..ops.layers import (global_pad_scale, linear_apply,
 from ..utils.config import ModelConfig, ScheduleConfig
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS,
                    SEQ_AXIS)
-from .schedules import (COL_BWD_ASLOT, COL_BWD_GSLOT, COL_BWD_M, COL_BWD_V,
-                        COL_FWD_M, COL_FWD_SLOT, COL_FWD_V, COL_STORE_B_SLOT,
+from .schedules import (COL_BWD_ASLOT, COL_BWD_GSLOT, COL_BWD_LOCAL_SLOT,
+                        COL_BWD_M, COL_BWD_V, COL_FWD_LOCAL_SLOT, COL_FWD_M,
+                        COL_FWD_SLOT, COL_FWD_V, COL_STORE_B_POS_SLOT,
+                        COL_STORE_B_SLOT, COL_STORE_F_NEG_SLOT,
                         COL_STORE_F_SLOT, COL_W_ASLOT, COL_W_GSLOT, COL_W_M,
                         COL_W_V, CompiledSchedule, compile_schedule)
 
@@ -77,7 +79,9 @@ def _compile(name: str, D: int, V: int, M: int) -> CompiledSchedule:
     functions are Python)."""
     from . import native
     from .schedules import is_custom
-    if is_custom(name):
+    if is_custom(name) or name == "ZBV":
+        # custom orders are Python functions; ZBV's order is synthesized by
+        # a Python greedy simulation the C++ engine does not mirror
         return compile_schedule(name, D, V, M)
     if native.native_available():
         from .schedules import ScheduleError
@@ -95,9 +99,20 @@ def _compile(name: str, D: int, V: int, M: int) -> CompiledSchedule:
 # ---------------------------------------------------------------------------
 
 
-def stack_stage_layers(layers: Pytree, n_devices: int, n_virtual: int) -> Pytree:
-    """[L, ...] leaves -> [D, V, L/S, ...]: device d, virtual v holds global
-    stage v*D + d (the reference's wrap placement)."""
+def _stage_index_map(placement: str, D: int, V: int):
+    """[D, V] array: global stage held by (device, chunk)."""
+    import numpy as np
+
+    from .schedules import placement_stage_of
+    return np.array([[placement_stage_of(placement, d, v, D)
+                      for v in range(V)] for d in range(D)])
+
+
+def stack_stage_layers(layers: Pytree, n_devices: int, n_virtual: int,
+                       placement: str = "wrap") -> Pytree:
+    """[L, ...] leaves -> [D, V, L/S, ...]: device d, chunk v holds global
+    stage ``placement_stage_of(d, v)`` — wrap (the reference's
+    ``stage = rank + world_size * v``) or vshape (ZB-V)."""
 
     def reshape(x):
         L = x.shape[0]
@@ -105,18 +120,27 @@ def stack_stage_layers(layers: Pytree, n_devices: int, n_virtual: int) -> Pytree
         if L % S != 0:
             raise ValueError(f"n_layers={L} must divide evenly into {S} stages")
         lps = L // S
-        return (x.reshape(n_virtual, n_devices, lps, *x.shape[1:])
-                .swapaxes(0, 1))
+        if placement == "wrap":
+            return (x.reshape(n_virtual, n_devices, lps, *x.shape[1:])
+                    .swapaxes(0, 1))
+        idx = _stage_index_map(placement, n_devices, n_virtual)
+        return x.reshape(S, lps, *x.shape[1:])[idx]
 
     return jax.tree.map(reshape, layers)
 
 
-def unstack_stage_layers(stacked: Pytree) -> Pytree:
+def unstack_stage_layers(stacked: Pytree, placement: str = "wrap") -> Pytree:
     """Inverse of :func:`stack_stage_layers`: [D, V, lps, ...] -> [L, ...]."""
 
     def reshape(x):
         D, V, lps = x.shape[:3]
-        return x.swapaxes(0, 1).reshape(V * D * lps, *x.shape[3:])
+        if placement == "wrap":
+            return x.swapaxes(0, 1).reshape(V * D * lps, *x.shape[3:])
+        idx = _stage_index_map(placement, D, V).reshape(-1)  # [D*V] -> stage
+        flat = x.reshape(D * V, lps, *x.shape[3:])
+        import numpy as np
+        inv = np.argsort(idx)  # stage -> (d, v) flat position
+        return flat[inv].reshape(V * D * lps, *x.shape[3:])
 
     return jax.tree.map(reshape, stacked)
 
@@ -231,6 +255,13 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     dtype = jnp.dtype(cfg.dtype)
     fwd_perm = [(i, (i + 1) % D) for i in range(D)]
     bwd_perm = [(i, (i - 1) % D) for i in range(D)]
+    # vshape placement (ZB-V): some transfers ride the reverse rings or stay
+    # on-device; the last stage lives at (device 0, chunk 1), not (D-1, V-1)
+    placement = cs.placement
+    reverse_routes = cs.uses_reverse_routes
+    from .schedules import (placement_chunk_of, placement_device_of)
+    last_dev = placement_device_of(placement, D * V - 1, D)
+    last_chunk = placement_chunk_of(placement, D * V - 1, D)
 
     lps = cfg.n_layers // (D * V)  # layers per stage (stack_stage_layers checks)
 
@@ -241,7 +272,13 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         d = jax.lax.axis_index(PIPE_AXIS)
         layers_local = jax.tree.map(lambda x: x[0], layers_stacked)
         is_first_dev = d == 0
-        is_last_dev = d == D - 1
+        is_last_dev = d == last_dev  # wrap: D-1; vshape: 0 (the V returns)
+
+        def stage_of(vv):
+            """Traced global stage index of this device's chunk vv."""
+            if placement == "wrap":
+                return vv * D + d
+            return jnp.where(vv == 0, d, 2 * D - 1 - d)
 
         if use_dropout:
             base_rng = jax.random.wrap_key_data(rng_data)
@@ -291,7 +328,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             if sp_axis is None:
                 return (body_apply(cfg, layer_p, x, tp_axis=tp_axis,
                                    tp_size=T, rng=mb_rng(mm),
-                                   layer_offset=(vv * D + d) * lps), zero)
+                                   layer_offset=stage_of(vv) * lps), zero)
             # sequence-sharded stage: ring/Ulysses attention across 'seq'
             # (ring optionally Megatron head-sharded over 'model' as well)
             from .seq_parallel import sp_body_apply
@@ -391,14 +428,33 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             return jax.tree.map(lambda n, o: jnp.where(pred, n, o),
                                 unit(operand), noop(operand))
 
+        def transfers(fwd_send, bwd_send):
+            """End-of-tick ring hops. Classic wrap placement: activations
+            ride +1, cotangents -1. With reverse routes (vshape), the same
+            send values ALSO ride the opposite rings — each consumer banks
+            only from the channel its table entry names, so the extra
+            copies are dead unless routed."""
+            fr = jax.lax.ppermute(fwd_send, PIPE_AXIS, fwd_perm)
+            br = jax.lax.ppermute(bwd_send, PIPE_AXIS, bwd_perm)
+            if not reverse_routes:
+                return (fr, br)
+            return (fr, br,
+                    jax.lax.ppermute(fwd_send, PIPE_AXIS, bwd_perm),
+                    jax.lax.ppermute(bwd_send, PIPE_AXIS, fwd_perm))
+
         def tick(carry, row_all):
-            (act_buf, grad_buf, fwd_recv, bwd_recv,
+            (act_buf, grad_buf, recvs,
              g_layers, g_embed, g_head, loss_acc) = carry
             row = row_all[d]
 
-            # 1. bank arrivals from last tick's ppermute
-            act_buf = masked_store(act_buf, fwd_recv, row[COL_STORE_F_SLOT])
-            grad_buf = masked_store(grad_buf, bwd_recv, row[COL_STORE_B_SLOT])
+            # 1. bank arrivals from last tick's ppermute channels
+            act_buf = masked_store(act_buf, recvs[0], row[COL_STORE_F_SLOT])
+            grad_buf = masked_store(grad_buf, recvs[1], row[COL_STORE_B_SLOT])
+            if reverse_routes:
+                act_buf = masked_store(act_buf, recvs[2],
+                                       row[COL_STORE_F_NEG_SLOT])
+                grad_buf = masked_store(grad_buf, recvs[3],
+                                        row[COL_STORE_B_POS_SLOT])
 
             # 2. forward unit
             fv, fm, fslot = row[COL_FWD_V], row[COL_FWD_M], row[COL_FWD_SLOT]
@@ -418,6 +474,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
             act_buf, fwd_send = run_unit(fm >= 0, fwd_unit, fwd_noop,
                                          act_buf)
+            if reverse_routes:
+                # same-device hop (vshape's V turning point): the output IS
+                # the next chunk's input — bank it locally, no ring transit
+                act_buf = masked_store(act_buf, fwd_send,
+                                       row[COL_FWD_LOCAL_SLOT])
 
             # 3. backward unit (rematerializing)
             bv, bm = row[COL_BWD_V], row[COL_BWD_M]
@@ -430,7 +491,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 # _backward.py:281) in ticks that would otherwise be bubble.
                 def dgrad_unit(loss_acc):
                     vv, mm = jnp.maximum(bv, 0), jnp.maximum(bm, 0)
-                    last_stage = is_last_dev & (vv == V - 1)
+                    last_stage = is_last_dev & (vv == last_chunk)
                     x = act_buf[jnp.maximum(row[COL_BWD_ASLOT], 0)]
                     g_in = grad_buf[jnp.maximum(row[COL_BWD_GSLOT], 0)]
                     params_v = select_v(layers_local, vv)
@@ -445,13 +506,16 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
                 loss_acc, bwd_send = run_unit(bm >= 0, dgrad_unit,
                                               dgrad_noop, loss_acc)
+                if reverse_routes:
+                    grad_buf = masked_store(grad_buf, bwd_send,
+                                            row[COL_BWD_LOCAL_SLOT])
 
                 wv, wm = row[COL_W_V], row[COL_W_M]
 
                 def wgrad_unit(operand):
                     g_layers, g_embed, g_head = operand
                     vv, mm = jnp.maximum(wv, 0), jnp.maximum(wm, 0)
-                    last_stage = is_last_dev & (vv == V - 1)
+                    last_stage = is_last_dev & (vv == last_chunk)
                     first_stage = is_first_dev & (vv == 0)
                     x_slot = act_buf[jnp.maximum(row[COL_W_ASLOT], 0)]
                     g_in = grad_buf[jnp.maximum(row[COL_W_GSLOT], 0)]
@@ -485,15 +549,13 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     wm >= 0, wgrad_unit, lambda op: op,
                     (g_layers, g_embed, g_head))
 
-                fwd_recv = jax.lax.ppermute(fwd_send, PIPE_AXIS, fwd_perm)
-                bwd_recv = jax.lax.ppermute(bwd_send, PIPE_AXIS, bwd_perm)
-                return (act_buf, grad_buf, fwd_recv, bwd_recv,
+                return (act_buf, grad_buf, transfers(fwd_send, bwd_send),
                         g_layers, g_embed, g_head, loss_acc), None
 
             def bwd_unit(operand):
                 g_layers, g_embed, g_head, loss_acc = operand
                 vv, mm = jnp.maximum(bv, 0), jnp.maximum(bm, 0)
-                last_stage = is_last_dev & (vv == V - 1)
+                last_stage = is_last_dev & (vv == last_chunk)
                 first_stage = is_first_dev & (vv == 0)
                 x = act_buf[jnp.maximum(row[COL_BWD_ASLOT], 0)]
                 g_in = grad_buf[jnp.maximum(row[COL_BWD_GSLOT], 0)]
@@ -528,26 +590,27 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             (g_layers, g_embed, g_head, loss_acc), bwd_send = run_unit(
                 bm >= 0, bwd_unit, bwd_noop,
                 (g_layers, g_embed, g_head, loss_acc))
+            if reverse_routes:
+                grad_buf = masked_store(grad_buf, bwd_send,
+                                        row[COL_BWD_LOCAL_SLOT])
 
-            # 4. ring transfer: activations +1, gradients -1 (ICI hops)
-            fwd_recv = jax.lax.ppermute(fwd_send, PIPE_AXIS, fwd_perm)
-            bwd_recv = jax.lax.ppermute(bwd_send, PIPE_AXIS, bwd_perm)
-
-            return (act_buf, grad_buf, fwd_recv, bwd_recv,
+            # 4. ring transfer: activations +1, gradients -1 (ICI hops);
+            # vshape placements add the two reverse channels
+            return (act_buf, grad_buf, transfers(fwd_send, bwd_send),
                     g_layers, g_embed, g_head, loss_acc), None
 
+        n_chan = 4 if reverse_routes else 2
         carry0 = (
             jnp.zeros((cs.n_act_slots,) + mb_shape, dtype),
             jnp.zeros((cs.n_grad_slots,) + mb_shape, dtype),
-            jnp.zeros(mb_shape, dtype),
-            jnp.zeros(mb_shape, dtype),
+            tuple(jnp.zeros(mb_shape, dtype) for _ in range(n_chan)),
             jax.tree.map(jnp.zeros_like, layers_local),
             jax.tree.map(jnp.zeros_like, embed),
             jax.tree.map(jnp.zeros_like, head),
             jnp.zeros((), jnp.float32),
         )
         carry, _ = jax.lax.scan(tick, carry0, table)
-        (_, _, _, _, g_layers, g_embed, g_head, loss_acc) = carry
+        (_, _, _, g_layers, g_embed, g_head, loss_acc) = carry
 
         # Reductions: loss lives on the last stage only; embed/head grads on
         # one device each — psum replicates them across 'pipe'. Scale by 1/M
@@ -642,7 +705,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     def unpack(loss, g_layers, g_embed, g_head):
         return loss, {
             "embed": g_embed,
-            "layers": unstack_stage_layers(g_layers),
+            "layers": unstack_stage_layers(g_layers, placement),
             "head": g_head,
         }
 
@@ -650,7 +713,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         # Train-mode step: the caller supplies a per-step PRNG key; passing
         # the key's raw data through shard_map sidesteps typed-key sharding.
         def step(params, tokens, targets, rng):
-            stacked = stack_stage_layers(params["layers"], D, V)
+            stacked = stack_stage_layers(params["layers"], D, V, placement)
             return unpack(*sharded(
                 stacked, params["embed"], params["head"], tokens, targets,
                 jax.random.key_data(rng)))
@@ -658,7 +721,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         return step
 
     def step(params, tokens, targets):
-        stacked = stack_stage_layers(params["layers"], D, V)
+        stacked = stack_stage_layers(params["layers"], D, V, placement)
         return unpack(*sharded(
             stacked, params["embed"], params["head"], tokens, targets))
 

@@ -39,7 +39,7 @@ F = "F"
 B = "B"  # full backward — or input-grad (dgrad) only under a split schedule
 W = "W"  # weight-grad (wgrad) — split schedules (ZB-H1) only
 
-SPLIT_BACKWARD_SCHEDULES = frozenset({"ZBH1"})
+SPLIT_BACKWARD_SCHEDULES = frozenset({"ZBH1", "ZBV"})
 
 # User-registered schedules: name -> (order_fn, split_backward).
 # ``order_fn(n_devices, n_virtual, n_microbatches) -> List[List[Action]]``.
@@ -88,7 +88,12 @@ def schedule_names() -> Tuple[str, ...]:
     return BUILTIN_SCHEDULE_NAMES + tuple(_CUSTOM_SCHEDULES)
 
 
-BUILTIN_SCHEDULE_NAMES = ("GPipe", "1F1B", "Interleaved1F1B", "ZBH1", "BFS")
+BUILTIN_SCHEDULE_NAMES = ("GPipe", "1F1B", "Interleaved1F1B", "ZBH1", "BFS",
+                          "ZBV")
+
+
+def schedule_placement(name: str) -> str:
+    return "vshape" if name == "ZBV" else "wrap"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -282,10 +287,115 @@ def zb_h1_order(n_devices: int, n_microbatches: int) -> List[List[Action]]:
     return orders
 
 
+def zb_v_order(n_devices: int, n_microbatches: int) -> List[List[Action]]:
+    """ZB-V (Qi et al., arXiv:2401.10241 §4): 2 chunks per device in the
+    V-shaped placement — device d holds stages d and 2D-1-d, so the last
+    forward stage and the first backward stage share device 0 and cotangents
+    begin flowing with no cross-device turnaround. Combined with the
+    dgrad/wgrad split, the warm pipeline has (near-)zero bubble at 1F1B's
+    activation memory.
+
+    The per-device order is synthesized by a greedy priority simulation
+    rather than transcribed from the paper's figure: at each tick every
+    device picks its highest-priority READY action (dgrad B first — it
+    unblocks a neighbor — then F, then W to fill leftover ticks), with
+    chunk-1 work preferred over chunk-0 so the V's return leg drains
+    eagerly. The validator/tick-scheduler then re-checks the result like
+    any other order. Stage 0 elides B per the ZB-H1 convention (no upstream
+    to send a cotangent to; its W carries the full parameter backward).
+    """
+    D, M = n_devices, n_microbatches
+    if D < 2:
+        raise ScheduleError("ZBV requires n_devices >= 2")
+    if M < 2 * D:
+        raise ScheduleError(
+            f"ZBV requires n_microbatches >= 2 * n_devices ({M} < {2 * D}); "
+            f"fewer microbatches cannot fill the V's steady state")
+    S = 2 * D
+
+    def device_of(s):
+        return placement_device_of("vshape", s, D)
+
+    # the full action set (split backward: no B on stage 0)
+    remaining = {(s, F, m) for s in range(S) for m in range(M)}
+    remaining |= {(s, W, m) for s in range(S) for m in range(M)}
+    remaining |= {(s, B, m) for s in range(1, S) for m in range(M)}
+    done: Dict[Tuple[int, str, int], int] = {}
+    orders: List[List[Action]] = [[] for _ in range(D)]
+    t = 0
+    limit = 8 * len(remaining) + 64
+
+    def ready(s, op, m, now):
+        if op == F:
+            if s == 0:
+                return True
+            d = done.get((s - 1, F, m))
+            return d is not None and d + 1 <= now
+        if (s, F, m) not in done:
+            return False
+        if op == W:
+            if s == 0:
+                d = done.get((1, B, m))
+                return d is not None and d + 1 <= now
+            if s == S - 1:
+                return True
+            return (s, B, m) in done
+        # dgrad B
+        if s == S - 1:
+            return True
+        d = done.get((s + 1, B, m))
+        return d is not None and d + 1 <= now
+
+    def priority(s, op, m):
+        # smaller sorts first: B before F before W; within an op, deeper
+        # stages (chunk 1, higher s) first so the return leg drains; then
+        # older microbatches
+        op_rank = {B: 0, F: 1, W: 2}[op]
+        return (op_rank, -s, m)
+
+    # Activation-memory cap: a device may hold at most ~2D+2 live stage
+    # inputs (its F count minus its W count — W is the releasing read of the
+    # saved input under the split backward). Without it the greedy front-
+    # loads every forward and peak memory degrades to GPipe's O(M·V);
+    # with it the slot allocator recovers 1F1B-class O(D) buffers (asserted
+    # in tests). The cap never deadlocks: the no-F fallback below still
+    # allows B/W, and B/W chains are always schedulable once their
+    # forwards ran.
+    live_cap = 2 * D + 2
+    n_f = [0] * D
+    n_w = [0] * D
+
+    while remaining:
+        if t > limit:
+            raise ScheduleError("ZBV synthesis deadlocked")
+        for d in range(D):
+            cands = sorted(
+                ((s, op, m) for (s, op, m) in remaining
+                 if device_of(s) == d and ready(s, op, m, t)
+                 and not (op == F and n_f[d] - n_w[d] >= live_cap)),
+                key=lambda a: priority(*a))
+            if cands:
+                s, op, m = cands[0]
+                remaining.discard((s, op, m))
+                done[(s, op, m)] = t
+                orders[d].append(Action(s, op, m))
+                if op == F:
+                    n_f[d] += 1
+                elif op == W:
+                    n_w[d] += 1
+        t += 1
+    return orders
+
+
 def build_order(name: str, n_devices: int, n_virtual: int,
                 n_microbatches: int) -> List[List[Action]]:
     if name in _CUSTOM_SCHEDULES:
         return _CUSTOM_SCHEDULES[name][0](n_devices, n_virtual, n_microbatches)
+    if name == "ZBV":
+        if n_virtual != 2:
+            raise ScheduleError("ZBV runs exactly 2 chunks per device "
+                                "(set n_virtual=2)")
+        return zb_v_order(n_devices, n_microbatches)
     if name == "ZBH1":
         if n_virtual != 1:
             raise ScheduleError("ZBH1 supports a single stage per device")
@@ -306,18 +416,57 @@ def build_order(name: str, n_devices: int, n_virtual: int,
 
 
 # ---------------------------------------------------------------------------
+# Stage placements
+# ---------------------------------------------------------------------------
+#
+# "wrap" (the reference's ``stage = rank + world_size * v``): device(s) = s % D.
+# Inter-stage transfers always travel +1 (fwd) / -1 (bwd) on the device ring.
+#
+# "vshape" (ZB-V, Qi et al. arXiv:2401.10241): V=2 chunks per device laid out
+# as a V — device(s) = s for s < D, else 2D-1-s. The s=D-1 -> D transfer stays
+# on-device; chunk-1 forwards travel -1 on the ring (and their cotangents +1).
+
+
+def placement_device_of(placement: str, stage: int, D: int) -> int:
+    if placement == "wrap":
+        return stage % D
+    if placement == "vshape":
+        return stage if stage < D else 2 * D - 1 - stage
+    raise ScheduleError(f"unknown placement {placement!r}")
+
+
+def placement_chunk_of(placement: str, stage: int, D: int) -> int:
+    """The local chunk index v such that stage_of(device, v) == stage."""
+    if placement == "wrap":
+        return stage // D
+    if placement == "vshape":
+        return 0 if stage < D else 1
+    raise ScheduleError(f"unknown placement {placement!r}")
+
+
+def placement_stage_of(placement: str, d: int, v: int, D: int) -> int:
+    if placement == "wrap":
+        return v * D + d
+    if placement == "vshape":
+        return d if v == 0 else 2 * D - 1 - d
+    raise ScheduleError(f"unknown placement {placement!r}")
+
+
+# ---------------------------------------------------------------------------
 # Tick scheduling (ASAP list scheduler)
 # ---------------------------------------------------------------------------
 
 
 def schedule_ticks(orders: List[List[Action]], n_devices: int, n_virtual: int,
-                   ) -> Tuple[Dict[Action, int], int]:
+                   placement: str = "wrap") -> Tuple[Dict[Action, int], int]:
     """Assign each action a tick. Returns (action -> tick, makespan).
 
     Rules: one action per device per tick; per-device actions run in list
     order; F(s, m) needs F(s-1, m) completed >= 1 tick earlier when the stages
     live on different devices (ppermute latency), B(s, m) needs F(s, m) (same
     device, activations saved locally) and B(s+1, m) >= 1 tick earlier.
+    (Same-device inter-stage transfers — vshape's s=D-1 -> D hop — need only
+    ``done + 1 <= now`` too, which one-action-per-tick already implies.)
 
     This is the deadlock-freedom analog of upstream's ``_validate_schedule``
     (``schedules.py:1619``) plus gloo's peer-sorted P2P batching
@@ -333,7 +482,7 @@ def schedule_ticks(orders: List[List[Action]], n_devices: int, n_virtual: int,
     limit = 4 * n_actions + 4 * S + 16
 
     def device_of(stage: int) -> int:
-        return stage % D
+        return placement_device_of(placement, stage, D)
 
     def ready(a: Action, now: int) -> bool:
         if a.op == F:
@@ -378,7 +527,8 @@ def schedule_ticks(orders: List[List[Action]], n_devices: int, n_virtual: int,
 
 
 def validate_order(orders: List[List[Action]], n_devices: int, n_virtual: int,
-                   n_microbatches: int, split_backward: bool = False) -> None:
+                   n_microbatches: int, split_backward: bool = False,
+                   placement: str = "wrap") -> None:
     """Structural validation: every (stage, microbatch) has exactly one F and
     one full B (or, under a split schedule, one W plus one dgrad B for every
     stage except 0), F precedes B/W per device, and the tick scheduler
@@ -408,7 +558,8 @@ def validate_order(orders: List[List[Action]], n_devices: int, n_virtual: int,
             f"action set mismatch: {len(seen)} actions vs expected {len(want)} "
             f"(missing {list(want - set(seen))[:4]}, "
             f"extra {list(set(seen) - want)[:4]})")
-    schedule_ticks(orders, n_devices, n_virtual)  # raises on deadlock
+    schedule_ticks(orders, n_devices, n_virtual,
+                   placement=placement)  # raises on deadlock
 
 
 # ---------------------------------------------------------------------------
@@ -419,14 +570,40 @@ def validate_order(orders: List[List[Action]], n_devices: int, n_virtual: int,
 # Buffers are slot-addressed: slots are allocated from actual activation
 # lifetimes, so 1F1B keeps its O(in-flight) activation-memory advantage over
 # GPipe's O(M) instead of always allocating M microbatch buffers.
-COL_STORE_F_SLOT = 0  # store incoming fwd activation -> act_buf[slot]
+COL_STORE_F_SLOT = 0  # store +1-channel fwd arrival -> act_buf[slot]
 COL_FWD_V, COL_FWD_M, COL_FWD_SLOT = 1, 2, 3  # forward unit: (v, m), input slot
-COL_STORE_B_SLOT = 4  # store incoming grad -> grad_buf[slot]
+COL_STORE_B_SLOT = 4  # store -1-channel grad arrival -> grad_buf[slot]
 COL_BWD_V, COL_BWD_M = 5, 6  # backward unit: (v, m)
 COL_BWD_ASLOT, COL_BWD_GSLOT = 7, 8  # saved-input slot, incoming-grad slot
 COL_W_V, COL_W_M = 9, 10  # weight-grad unit (split schedules): (v, m)
 COL_W_ASLOT, COL_W_GSLOT = 11, 12  # its saved-input slot, incoming-grad slot
-N_COLS = 13
+# vshape-placement routes (always -1 under wrap placement, so wrap tables
+# are bit-identical to the 13-column era):
+N_COLS_CLASSIC = 13  # the wrap-placement-only column count
+COL_FWD_LOCAL_SLOT = 13  # fwd output -> OWN act_buf[slot] (same-device hop)
+COL_STORE_F_NEG_SLOT = 14  # store -1-channel fwd arrival -> act_buf[slot]
+COL_BWD_LOCAL_SLOT = 15  # bwd cotangent -> OWN grad_buf[slot]
+COL_STORE_B_POS_SLOT = 16  # store +1-channel grad arrival -> grad_buf[slot]
+N_COLS = 17
+
+
+def fwd_route(placement: str, s: int, D: int) -> str:
+    """Where F(s)'s output travels to reach stage s+1: '+1' ring, '-1' ring,
+    or 'local' (same device)."""
+    if placement == "wrap":
+        return "+1"
+    if s == D - 1:
+        return "local"  # the V's turning point
+    return "+1" if s < D - 1 else "-1"
+
+
+def bwd_route(placement: str, s: int, D: int) -> str:
+    """Where B(s)'s cotangent travels to reach stage s-1."""
+    if placement == "wrap":
+        return "-1"
+    if s == D:
+        return "local"
+    return "+1" if s > D else "-1"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -446,10 +623,19 @@ class CompiledSchedule:
     # unregister/overwrite silently change an already-compiled schedule's
     # semantics.
     split_backward: bool = False
+    # "wrap" (stage = v*D + d) or "vshape" (ZB-V: device d holds stages d
+    # and 2D-1-d; some transfers ride the -1 ring or stay on-device).
+    placement: str = "wrap"
 
     @property
     def n_stages(self) -> int:
         return self.n_devices * self.n_virtual
+
+    @property
+    def uses_reverse_routes(self) -> bool:
+        """True when the table uses the -1 fwd / +1 bwd channels or local
+        hops — the executor then issues the two extra ppermutes."""
+        return bool(np.any(self.table[:, :, N_COLS_CLASSIC:] >= 0))
 
 
 def _allocate_slots(events: List[Tuple[int, int, object]]) -> Tuple[Dict[object, int], int]:
@@ -493,36 +679,49 @@ def compile_schedule(name: str, n_devices: int, n_virtual: int,
     """
     D, V, M = n_devices, n_virtual, n_microbatches
     split = is_split_backward(name)
+    placement = schedule_placement(name)
     orders = build_order(name, D, V, M)
-    validate_order(orders, D, V, M, split_backward=split)
-    ticks, T_compute = schedule_ticks(orders, D, V)
+    validate_order(orders, D, V, M, split_backward=split,
+                   placement=placement)
+    ticks, T_compute = schedule_ticks(orders, D, V, placement=placement)
     S = D * V
+
+    def device_of(s):
+        return placement_device_of(placement, s, D)
+
     # +1: arrivals land one tick after the producing compute; the final
     # backward of stage 0 produces no arrival, but a last-tick forward of a
     # non-final stage (never happens in practice) would need T_compute + 1.
     T = T_compute + 1
 
     # Activation lifetimes per device: input of stage s for microbatch m is
-    # written at the producer's tick + 1 (arrival) — or at the forward tick
-    # itself for global stage 0 (the embed is computed in place) — and last
-    # read by B(s, m), or by W(s, m) under a split schedule (W runs after B
-    # by list order, so W is the releasing read). Grad lifetimes: written at
-    # B(s+1, m) + 1, last read by whichever of B(s, m) / W(s, m) runs later.
+    # written at the producer's tick + 1 (ring arrival) — at the producer's
+    # tick itself for a same-device hop, or at the forward tick for global
+    # stage 0 (the embed is computed in place) — and last read by B(s, m),
+    # or by W(s, m) under a split schedule (W runs after B by list order, so
+    # W is the releasing read). Grad lifetimes mirror this for B(s+1, m).
     act_events: List[List[Tuple[int, int, object]]] = [[] for _ in range(D)]
     grad_events: List[List[Tuple[int, int, object]]] = [[] for _ in range(D)]
     for a, t in ticks.items():
         if a.op != F:
             continue
-        d = a.stage % D
-        store = t if a.stage == 0 else ticks[Action(a.stage - 1, F, a.microbatch)] + 1
+        d = device_of(a.stage)
+        if a.stage == 0:
+            store = t
+        else:
+            pt = ticks[Action(a.stage - 1, F, a.microbatch)]
+            local = fwd_route(placement, a.stage - 1, D) == "local"
+            store = pt if local else pt + 1
         release = max(ticks[r] for r in (Action(a.stage, B, a.microbatch),
                                          Action(a.stage, W, a.microbatch))
                       if r in ticks)
         act_events[d].append((store, release, (a.stage, a.microbatch)))
     for s in range(S - 1):
-        d = s % D
+        d = device_of(s)
         for m in range(M):
-            store = ticks[Action(s + 1, B, m)] + 1
+            pt = ticks[Action(s + 1, B, m)]
+            local = bwd_route(placement, s + 1, D) == "local"
+            store = pt if local else pt + 1
             release = max(ticks[r] for r in (Action(s, B, m), Action(s, W, m))
                           if r in ticks)
             grad_events[d].append((store, release, (s, m)))
@@ -540,27 +739,39 @@ def compile_schedule(name: str, n_devices: int, n_virtual: int,
 
     table = np.full((T, D, N_COLS), -1, dtype=np.int32)
     for a, t in ticks.items():
-        d = a.stage % D
-        v = a.stage // D
+        d = device_of(a.stage)
+        v = placement_chunk_of(placement, a.stage, D)
         if a.op == F:
             slot = act_assign[d][(a.stage, a.microbatch)]
             table[t, d, COL_FWD_V] = v
             table[t, d, COL_FWD_M] = a.microbatch
             table[t, d, COL_FWD_SLOT] = slot
-            if a.stage < S - 1:  # activation arrives at the next stage at t+1
-                nd = (a.stage + 1) % D
+            if a.stage < S - 1:
+                nd = device_of(a.stage + 1)
                 nslot = act_assign[nd][(a.stage + 1, a.microbatch)]
-                table[t + 1, nd, COL_STORE_F_SLOT] = nslot
+                route = fwd_route(placement, a.stage, D)
+                if route == "local":
+                    table[t, d, COL_FWD_LOCAL_SLOT] = nslot
+                elif route == "+1":
+                    table[t + 1, nd, COL_STORE_F_SLOT] = nslot
+                else:  # "-1"
+                    table[t + 1, nd, COL_STORE_F_NEG_SLOT] = nslot
         elif a.op == B:
             table[t, d, COL_BWD_V] = v
             table[t, d, COL_BWD_M] = a.microbatch
             table[t, d, COL_BWD_ASLOT] = act_assign[d][(a.stage, a.microbatch)]
             if a.stage < S - 1:
                 table[t, d, COL_BWD_GSLOT] = grad_assign[d][(a.stage, a.microbatch)]
-            if a.stage > 0:  # grad arrives at the previous stage at t+1
-                pd = (a.stage - 1) % D
+            if a.stage > 0:
+                pd = device_of(a.stage - 1)
                 pslot = grad_assign[pd][(a.stage - 1, a.microbatch)]
-                table[t + 1, pd, COL_STORE_B_SLOT] = pslot
+                route = bwd_route(placement, a.stage, D)
+                if route == "local":
+                    table[t, d, COL_BWD_LOCAL_SLOT] = pslot
+                elif route == "-1":
+                    table[t + 1, pd, COL_STORE_B_SLOT] = pslot
+                else:  # "+1"
+                    table[t + 1, pd, COL_STORE_B_POS_SLOT] = pslot
         else:  # W (wgrad)
             table[t, d, COL_W_V] = v
             table[t, d, COL_W_M] = a.microbatch
@@ -571,40 +782,56 @@ def compile_schedule(name: str, n_devices: int, n_virtual: int,
     while T > 1 and np.all(table[T - 1] == -1):
         T -= 1
     cs = CompiledSchedule(name, D, V, M, table[:T], T, ticks, n_act, n_grad,
-                          split_backward=split)
+                          split_backward=split, placement=placement)
     verify_table(cs)
     return cs
 
 
 def verify_table(cs: CompiledSchedule) -> None:
     """Symbolic interpreter over the compiled table: executes the exact
-    store/compute/permute contract the SPMD executor uses and checks that
-    every forward reads the right stage input and every backward reads the
-    right saved input and incoming cotangent. Raises ScheduleError on any
-    stale read, overwrite of a live value, or missing data."""
+    store/compute/permute contract the SPMD executor uses — four transfer
+    channels (+1/-1 for each direction) plus same-device hops — and checks
+    that every forward reads the right stage input and every backward reads
+    the right saved input and incoming cotangent. Raises ScheduleError on
+    any stale read, overwrite of a live value, or missing data."""
     D, V, S = cs.n_devices, cs.n_virtual, cs.n_stages
+    pl = cs.placement
     act = [dict() for _ in range(D)]   # slot -> ("act", stage, mb)
     grad = [dict() for _ in range(D)]  # slot -> ("gout", stage, mb)
-    fwd_in = [None] * D  # value delivered by last tick's +1 ppermute
-    bwd_in = [None] * D
+    fwd_in = [None] * D  # value delivered by last tick's +1 fwd ppermute
+    fwd_in_neg = [None] * D  # ... -1 fwd channel (vshape chunk-1 forwards)
+    bwd_in = [None] * D  # -1 bwd channel
+    bwd_in_pos = [None] * D  # +1 bwd channel (vshape chunk-1 cotangents)
     fwd_done = set()
     bwd_done = set()
     w_done = set()
     for t in range(cs.table.shape[0]):
-        fwd_send = [None] * D
+        fwd_send = [None] * D  # routed to +1, -1, or local per fwd_route
+        fwd_send_neg = [None] * D
         bwd_send = [None] * D
+        bwd_send_pos = [None] * D
         for d in range(D):
             row = cs.table[t, d]
             if row[COL_STORE_F_SLOT] >= 0:
                 if fwd_in[d] is None:
                     raise ScheduleError(f"t={t} d={d}: fwd store of empty register")
                 act[d][int(row[COL_STORE_F_SLOT])] = fwd_in[d]
+            if row[COL_STORE_F_NEG_SLOT] >= 0:
+                if fwd_in_neg[d] is None:
+                    raise ScheduleError(
+                        f"t={t} d={d}: fwd-neg store of empty register")
+                act[d][int(row[COL_STORE_F_NEG_SLOT])] = fwd_in_neg[d]
             if row[COL_STORE_B_SLOT] >= 0:
                 if bwd_in[d] is None:
                     raise ScheduleError(f"t={t} d={d}: bwd store of empty register")
                 grad[d][int(row[COL_STORE_B_SLOT])] = bwd_in[d]
+            if row[COL_STORE_B_POS_SLOT] >= 0:
+                if bwd_in_pos[d] is None:
+                    raise ScheduleError(
+                        f"t={t} d={d}: bwd-pos store of empty register")
+                grad[d][int(row[COL_STORE_B_POS_SLOT])] = bwd_in_pos[d]
             if row[COL_FWD_M] >= 0:
-                s = int(row[COL_FWD_V]) * D + d
+                s = placement_stage_of(pl, d, int(row[COL_FWD_V]), D)
                 m = int(row[COL_FWD_M])
                 slot = int(row[COL_FWD_SLOT])
                 if s == 0:
@@ -614,10 +841,21 @@ def verify_table(cs: CompiledSchedule) -> None:
                     raise ScheduleError(
                         f"t={t} d={d}: F(stage={s}, mb={m}) read slot {slot} "
                         f"holding {got}")
-                fwd_send[d] = ("act", s + 1, m)
+                if s < S - 1:
+                    route = fwd_route(pl, s, D)
+                    if route == "local":
+                        if row[COL_FWD_LOCAL_SLOT] < 0:
+                            raise ScheduleError(
+                                f"t={t} d={d}: F(stage={s}) local route "
+                                f"without COL_FWD_LOCAL_SLOT")
+                        act[d][int(row[COL_FWD_LOCAL_SLOT])] = ("act", s + 1, m)
+                    elif route == "+1":
+                        fwd_send[d] = ("act", s + 1, m)
+                    else:
+                        fwd_send_neg[d] = ("act", s + 1, m)
                 fwd_done.add((s, m))
             if row[COL_BWD_M] >= 0:
-                s = int(row[COL_BWD_V]) * D + d
+                s = placement_stage_of(pl, d, int(row[COL_BWD_V]), D)
                 m = int(row[COL_BWD_M])
                 aslot = int(row[COL_BWD_ASLOT])
                 got = act[d].get(aslot)
@@ -632,10 +870,21 @@ def verify_table(cs: CompiledSchedule) -> None:
                         raise ScheduleError(
                             f"t={t} d={d}: B(stage={s}, mb={m}) grad slot "
                             f"{gslot} holds {gg}")
-                bwd_send[d] = ("gout", s - 1, m) if s > 0 else None
+                if s > 0:
+                    route = bwd_route(pl, s, D)
+                    if route == "local":
+                        if row[COL_BWD_LOCAL_SLOT] < 0:
+                            raise ScheduleError(
+                                f"t={t} d={d}: B(stage={s}) local route "
+                                f"without COL_BWD_LOCAL_SLOT")
+                        grad[d][int(row[COL_BWD_LOCAL_SLOT])] = ("gout", s - 1, m)
+                    elif route == "-1":
+                        bwd_send[d] = ("gout", s - 1, m)
+                    else:
+                        bwd_send_pos[d] = ("gout", s - 1, m)
                 bwd_done.add((s, m))
             if row[COL_W_M] >= 0:
-                s = int(row[COL_W_V]) * D + d
+                s = placement_stage_of(pl, d, int(row[COL_W_V]), D)
                 m = int(row[COL_W_M])
                 aslot = int(row[COL_W_ASLOT])
                 got = act[d].get(aslot)
@@ -652,7 +901,9 @@ def verify_table(cs: CompiledSchedule) -> None:
                             f"{gslot} holds {gg}")
                 w_done.add((s, m))
         fwd_in = [fwd_send[(d - 1) % D] for d in range(D)]
+        fwd_in_neg = [fwd_send_neg[(d + 1) % D] for d in range(D)]
         bwd_in = [bwd_send[(d + 1) % D] for d in range(D)]
+        bwd_in_pos = [bwd_send_pos[(d - 1) % D] for d in range(D)]
     want = {(s, m) for s in range(S) for m in range(cs.n_microbatches)}
     if cs.split_backward:
         want_b = {(s, m) for s in range(1, S) for m in range(cs.n_microbatches)}
@@ -683,9 +934,9 @@ def analytic_bubble_fraction(name: str, n_devices: int, n_virtual: int,
     1F1B shows in :func:`simulated_bubble` with w_b=w_w=1 vs full w_b=2).
     """
     D, M = n_devices, n_microbatches
-    if name in _CUSTOM_SCHEDULES:
-        # no closed form for arbitrary registered orders: report the
-        # unit-cost tick simulation, which IS the executor's time model
+    if name in _CUSTOM_SCHEDULES or name == "ZBV":
+        # no closed form for arbitrary registered/synthesized orders: report
+        # the unit-cost tick simulation, which IS the executor's time model
         # (pass the caller's already-compiled ``cs`` to skip a recompile)
         if cs is None:
             cs = compile_schedule(name, D, n_virtual, M)

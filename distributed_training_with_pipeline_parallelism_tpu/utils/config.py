@@ -169,9 +169,11 @@ def _check_schedule_name(name: str) -> None:
 
 def virtual_stages_for(schedule_name: str, n_layers: int, n_pipe: int) -> int:
     """Reference rule for stages-per-worker (``LLMsDistributedTrainingHelper.py:181-185``).
-    Custom registered schedules get 1 (the rule only special-cases
-    Interleaved)."""
+    ZBV always runs its 2 V-placed chunks; custom registered schedules get 1
+    (the reference rule only special-cases Interleaved)."""
     _check_schedule_name(schedule_name)
+    if schedule_name == "ZBV":
+        return 2
     if schedule_name == "Interleaved1F1B" and n_layers % (n_pipe * 2) == 0:
         return 2
     return 1

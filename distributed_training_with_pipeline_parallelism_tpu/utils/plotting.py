@@ -23,7 +23,8 @@ def _mpl():
 
 SCHEDULE_COLORS = {"GPipe": "tab:blue", "1F1B": "tab:orange",
                    "Interleaved1F1B": "tab:green",
-                   "ZBH1": "tab:red", "BFS": "tab:purple"}
+                   "ZBH1": "tab:red", "BFS": "tab:purple",
+                   "ZBV": "tab:brown"}
 PROC_MARKERS = {2: "o", 4: "s", 8: "^", 16: "D"}
 
 

@@ -52,6 +52,10 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
 
     try:
         n_virtual = virtual_stages_for(schedule_type, n_layers, num_devices)
+        if schedule_type == "ZBV":
+            # ZBV's steady state needs M >= 2D; lift the reference's fixed 4
+            # where required (recorded in the row's n_microbatches column)
+            n_microbatches = max(n_microbatches, 2 * num_devices)
         cfg = ModelConfig(dim=dim, n_layers=n_layers, n_heads=n_heads,
                           vocab_size=vocab_size, arch=arch, dtype=dtype)
         sched = ScheduleConfig(name=schedule_type,
@@ -74,6 +78,7 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
         metrics.update({
             "throughput_per_chip": metrics["throughput"] / num_devices,
             "n_virtual": n_virtual,
+            "n_microbatches": n_microbatches,
             "bubble_analytic": analytic_bubble_fraction(
                 schedule_type, num_devices, n_virtual, n_microbatches, cs=cs),
             "bubble_simulated": sim["bubble_fraction"],

@@ -116,6 +116,7 @@ def test_pipeline_matches_manual_microbatched_reference(problem):
     ("1F1B", 4, 1, 4),
     ("Interleaved1F1B", 2, 2, 4),
     ("BFS", 2, 2, 4),
+    ("ZBV", 2, 2, 4),
 ])
 def test_pipeline_dropout_partition_invariance(problem, name, D, V, M):
     """Same step key, different stage partitions -> identical loss and grads:

@@ -58,6 +58,7 @@ def test_masked_loss_matches_torch_semantics(problem):
     ("Interleaved1F1B", 2, 1, 2, 4),
     ("ZBH1", 2, 1, 1, 4),
     ("1F1B", 2, 2, 1, 2),  # DP with UNEVEN valid counts across shards
+    ("ZBV", 2, 1, 2, 4),
 ])
 def test_pipeline_masked_matches_single_device(problem, name, D, n_data, V, M):
     params, tokens, targets = problem

@@ -51,6 +51,8 @@ def assert_matches_reference(loss, grads, ref_loss, ref_grads, tol=1e-5):
     ("BFS", 2, 2, 4),
     ("BFS", 4, 2, 4),
     ("BFS", 2, 4, 2),
+    ("ZBV", 2, 2, 4),
+    ("ZBV", 4, 2, 8),
 ])
 def test_pipeline_matches_single_device(problem, name, D, V, M):
     params, tokens, targets, ref_loss, ref_grads = problem

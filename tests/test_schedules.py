@@ -108,6 +108,40 @@ def test_bfs_shrinks_bubble_like_interleaved():
     assert b_bfs["bubble_fraction"] == pytest.approx(ana, rel=0.15)
 
 
+def test_zbv_placement_and_bubble():
+    # V placement: device d holds stages d and 2D-1-d; the compiled table
+    # self-verifies (symbolic interpreter models reverse/local routes)
+    D, M = 4, 8
+    cs = compile_schedule("ZBV", D, 2, M)
+    assert cs.placement == "vshape" and cs.split_backward
+    assert cs.uses_reverse_routes
+    # strictly smaller unit-cost bubble than ZB-H1 at the same (D, M)
+    zbv = simulated_bubble(cs, 1.0, 1.0, 1.0)["bubble_fraction"]
+    zbh1 = simulated_bubble(compile_schedule("ZBH1", D, 1, M),
+                            1.0, 1.0, 1.0)["bubble_fraction"]
+    assert zbv < zbh1, (zbv, zbh1)
+    # 1F1B-class activation memory, not GPipe's O(M*V)
+    assert cs.n_act_slots <= 2 * D + 6, cs.n_act_slots
+
+
+def test_zbv_constraints():
+    with pytest.raises(ScheduleError):
+        build_order("ZBV", 4, 1, 8)  # needs exactly 2 chunks
+    with pytest.raises(ScheduleError):
+        build_order("ZBV", 4, 2, 4)  # needs M >= 2D
+    with pytest.raises(ScheduleError):
+        build_order("ZBV", 1, 2, 4)  # needs D >= 2
+
+
+def test_wrap_tables_do_not_use_reverse_routes():
+    # classic schedules stay on the two classic channels (and therefore
+    # compile bit-identically in the C++ engine)
+    for name, V in [("GPipe", 1), ("1F1B", 1), ("Interleaved1F1B", 2),
+                    ("ZBH1", 1), ("BFS", 2)]:
+        cs = compile_schedule(name, 4, V, 8)
+        assert not cs.uses_reverse_routes, name
+
+
 def test_gpipe_makespan_matches_analytic():
     # unit-cost fill-drain makespan: 2M + 2(D-1) compute ticks
     for D, M in [(2, 4), (4, 4), (4, 8)]:

@@ -54,6 +54,7 @@ def problem():
     ("Interleaved1F1B", 2, 1, 2, 4),
     ("ZBH1", 2, 1, 1, 4),
     ("1F1B", 2, 2, 1, 2),
+    ("ZBV", 2, 1, 2, 4),
 ])
 def test_pipeline_tied_grads_match_single_device(problem, name, D, n_data, V, M):
     """Embedding grads must sum the lookup (stage 0) and head (last stage)
