@@ -127,10 +127,6 @@ def llama_config_from_hf(hf_config) -> ModelConfig:
             raise NotImplementedError(
                 f"rope_scaling={scaling!r} is not supported by this "
                 f"converter; plain RoPE and rope_type='llama3' are")
-    if getattr(hf_config, "attention_bias", False):
-        raise NotImplementedError(
-            "attention_bias=True checkpoints are not supported (projection "
-            "biases would be dropped)")
     if getattr(hf_config, "mlp_bias", False):
         raise NotImplementedError(
             "mlp_bias=True checkpoints are not supported (gate/up/down "
@@ -141,6 +137,27 @@ def llama_config_from_hf(hf_config) -> ModelConfig:
             f"decoupled head_dim={hd} != hidden_size/num_heads="
             f"{hf_config.hidden_size // hf_config.num_attention_heads} "
             f"(Mistral-Nemo-class checkpoints) is not supported")
+    # Qwen2 always carries q/k/v biases (its config has no attention_bias
+    # field) and no o bias. Llama's attention_bias=True puts a bias on
+    # o_proj TOO — this framework's blocks have no o bias, so importing
+    # would silently drop it; refuse instead.
+    qkv_bias = hf_config.model_type == "qwen2"
+    if getattr(hf_config, "attention_bias", False):
+        raise NotImplementedError(
+            "Llama attention_bias=True checkpoints are not supported (the "
+            "o_proj bias would be dropped; Qwen2's qkv-only biases are)")
+    window = getattr(hf_config, "sliding_window", None)
+    if hf_config.model_type == "qwen2":
+        if not getattr(hf_config, "use_sliding_window", False):
+            window = None  # qwen2 configs carry the field but default it off
+        elif getattr(hf_config, "max_window_layers", 0) > 0:
+            # HF windows only layers >= max_window_layers; this framework's
+            # sliding_window is uniform — a silent import would window
+            # layers HF attends fully
+            raise NotImplementedError(
+                "qwen2 use_sliding_window with max_window_layers > 0 mixes "
+                "full and windowed layers; only uniform windowing "
+                "(max_window_layers=0) is supported")
     return ModelConfig(
         dim=hf_config.hidden_size, n_layers=hf_config.num_hidden_layers,
         n_heads=hf_config.num_attention_heads,
@@ -149,7 +166,8 @@ def llama_config_from_hf(hf_config) -> ModelConfig:
         max_seq_len=hf_config.max_position_embeddings, arch="llama",
         rope_theta=float(hf_config.rope_theta),
         rope_scaling=rope_scaling,
-        sliding_window=getattr(hf_config, "sliding_window", None),
+        sliding_window=window,
+        attention_qkv_bias=qkv_bias,
         rms_eps=float(hf_config.rms_norm_eps),
         tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)))
 
@@ -158,21 +176,26 @@ def llama_params_from_hf(model_or_sd, cfg: ModelConfig) -> Pytree:
     sd = _state_dict(model_or_sd)
     pre = "model." if any(k.startswith("model.") for k in sd) else ""
 
-    def lin_t(name):  # torch nn.Linear [out, in] -> [in, out], no bias
-        return {"w": sd[name].T}
+    def lin_t(name, bias=False):  # torch nn.Linear [out, in] -> [in, out]
+        p = {"w": sd[name + ".weight"].T}
+        if bias:
+            p["b"] = sd[name + ".bias"]
+        return p
+
+    qkv_bias = cfg.attention_qkv_bias
 
     def layer(i):
         p = f"{pre}layers.{i}."
         return {
             "rms1": {"scale": sd[p + "input_layernorm.weight"]},
-            "attn": {"q": lin_t(p + "self_attn.q_proj.weight"),
-                     "k": lin_t(p + "self_attn.k_proj.weight"),
-                     "v": lin_t(p + "self_attn.v_proj.weight"),
-                     "o": lin_t(p + "self_attn.o_proj.weight")},
+            "attn": {"q": lin_t(p + "self_attn.q_proj", qkv_bias),
+                     "k": lin_t(p + "self_attn.k_proj", qkv_bias),
+                     "v": lin_t(p + "self_attn.v_proj", qkv_bias),
+                     "o": lin_t(p + "self_attn.o_proj")},
             "rms2": {"scale": sd[p + "post_attention_layernorm.weight"]},
-            "w1": lin_t(p + "mlp.gate_proj.weight"),
-            "w2": lin_t(p + "mlp.down_proj.weight"),
-            "w3": lin_t(p + "mlp.up_proj.weight"),
+            "w1": lin_t(p + "mlp.gate_proj"),
+            "w2": lin_t(p + "mlp.down_proj"),
+            "w3": lin_t(p + "mlp.up_proj"),
         }
 
     embed = sd[pre + "embed_tokens.weight"]
@@ -205,15 +228,17 @@ _CONVERTERS = {
     # Mistral = llama blocks + sliding-window attention; identical state
     # dict layout, window carried via config.sliding_window
     "mistral": (llama_config_from_hf, llama_params_from_hf),
+    # Qwen2 = llama blocks + q/k/v biases (attention_qkv_bias)
+    "qwen2": (llama_config_from_hf, llama_params_from_hf),
 }
 
 
 def from_hf(model, dtype: str = "float32") -> Tuple[ModelConfig, Pytree]:
     """Convert a ``transformers`` causal-LM model to (ModelConfig, params).
 
-    Dispatches on the HF config's ``model_type`` ("gpt2", "llama", or
-    "mistral" — Mistral shares the llama converter, carrying its
-    sliding window).
+    Dispatches on the HF config's ``model_type``: "gpt2", "llama",
+    "mistral" (llama converter + sliding window), or "qwen2" (llama
+    converter + q/k/v biases).
     """
     import dataclasses
 
@@ -287,6 +312,10 @@ def llama_state_dict(cfg: ModelConfig, params: Pytree) -> Dict[str, np.ndarray]:
         sd[p + "self_attn.k_proj.weight"] = _f32(a["k"]["w"][i]).T
         sd[p + "self_attn.v_proj.weight"] = _f32(a["v"]["w"][i]).T
         sd[p + "self_attn.o_proj.weight"] = _f32(a["o"]["w"][i]).T
+        if cfg.attention_qkv_bias:
+            sd[p + "self_attn.q_proj.bias"] = _f32(a["q"]["b"][i])
+            sd[p + "self_attn.k_proj.bias"] = _f32(a["k"]["b"][i])
+            sd[p + "self_attn.v_proj.bias"] = _f32(a["v"]["b"][i])
         sd[p + "post_attention_layernorm.weight"] = _f32(ly["rms2"]["scale"][i])
         sd[p + "mlp.gate_proj.weight"] = _f32(ly["w1"]["w"][i]).T
         sd[p + "mlp.down_proj.weight"] = _f32(ly["w2"]["w"][i]).T
@@ -331,7 +360,22 @@ def to_hf(cfg: ModelConfig, params: Pytree):
             max_position_embeddings=cfg.max_seq_len,
             rms_norm_eps=cfg.rms_eps, rope_theta=cfg.rope_theta,
             tie_word_embeddings=cfg.tie_embeddings)
-        if cfg.sliding_window is not None:
+        if cfg.attention_qkv_bias:
+            # Qwen2: llama blocks + always-on q/k/v biases
+            if cfg.rope_scaling is not None:
+                raise NotImplementedError(
+                    "attention_qkv_bias + rope_scaling: Qwen2Config carries "
+                    "no llama3 rope_scaling field")
+            hf_cfg = transformers.Qwen2Config(
+                use_sliding_window=cfg.sliding_window is not None,
+                sliding_window=cfg.sliding_window or cfg.max_seq_len,
+                # 0: window EVERY exported layer — this framework's window
+                # is uniform, and HF's default (28) would silently disable
+                # the window on models up to 28 layers
+                max_window_layers=0,
+                **common)
+            model = transformers.Qwen2ForCausalLM(hf_cfg)
+        elif cfg.sliding_window is not None:
             if cfg.rope_scaling is not None:
                 raise NotImplementedError(
                     "sliding_window + rope_scaling: MistralConfig carries no "

@@ -40,6 +40,15 @@ def llama_config(name: str = "llama2-7b", **overrides) -> ModelConfig:
                             ffn_dim=8192, vocab_size=128256, rope_theta=5e5,
                             max_seq_len=131072, tie_embeddings=True,
                             rope_scaling=(32.0, 1.0, 4.0, 8192)),
+        # Qwen2: llama blocks + q/k/v biases; 0.5B ties its embeddings
+        "qwen2-0.5b": dict(dim=896, n_layers=24, n_heads=14, n_kv_heads=2,
+                           ffn_dim=4864, vocab_size=151936, rope_theta=1e6,
+                           max_seq_len=32768, attention_qkv_bias=True,
+                           tie_embeddings=True, rms_eps=1e-6),
+        "qwen2-7b": dict(dim=3584, n_layers=28, n_heads=28, n_kv_heads=4,
+                         ffn_dim=18944, vocab_size=152064, rope_theta=1e6,
+                         max_seq_len=32768, attention_qkv_bias=True,
+                         rms_eps=1e-6),
         # scaled-down variant with the same shape ratios for tests/benches
         "llama-debug": dict(dim=256, n_layers=8, n_heads=8, n_kv_heads=4,
                             ffn_dim=688, vocab_size=1024, rope_theta=1e4),

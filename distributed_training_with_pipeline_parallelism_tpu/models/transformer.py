@@ -62,7 +62,8 @@ def layer_init(key: jax.Array, cfg: ModelConfig) -> Dict:
     if cfg.arch == "llama":
         return {
             "rms1": rms_norm_init(cfg.dim),
-            "attn": mha_init(ks[0], cfg.dim, cfg.n_heads, cfg.n_kv_heads, bias=False),
+            "attn": mha_init(ks[0], cfg.dim, cfg.n_heads, cfg.n_kv_heads,
+                             bias=cfg.attention_qkv_bias, o_bias=False),
             "rms2": rms_norm_init(cfg.dim),
             "w1": linear_init(ks[2], cfg.dim, cfg.ffn_dim, bias=False),
             "w2": linear_init(ks[3], cfg.ffn_dim, cfg.dim, bias=False),

@@ -21,7 +21,9 @@ from .layers import dropout_apply, linear_init, linear_apply
 
 
 def mha_init(key: jax.Array, dim: int, n_heads: int, n_kv_heads: Optional[int] = None,
-             bias: bool = True) -> Dict:
+             bias: bool = True, o_bias: Optional[bool] = None) -> Dict:
+    """``bias`` covers q/k/v; ``o_bias`` the output projection (defaults to
+    ``bias`` — Qwen2-family blocks set bias=True, o_bias=False)."""
     n_kv_heads = n_kv_heads or n_heads
     head_dim = dim // n_heads
     kq, kk, kv, ko = jax.random.split(key, 4)
@@ -29,7 +31,8 @@ def mha_init(key: jax.Array, dim: int, n_heads: int, n_kv_heads: Optional[int] =
         "q": linear_init(kq, dim, n_heads * head_dim, bias=bias),
         "k": linear_init(kk, dim, n_kv_heads * head_dim, bias=bias),
         "v": linear_init(kv, dim, n_kv_heads * head_dim, bias=bias),
-        "o": linear_init(ko, n_heads * head_dim, dim, bias=bias),
+        "o": linear_init(ko, n_heads * head_dim, dim,
+                         bias=bias if o_bias is None else o_bias),
     }
 
 

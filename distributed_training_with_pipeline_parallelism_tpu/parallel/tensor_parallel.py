@@ -50,14 +50,18 @@ def _layer_specs(cfg: ModelConfig) -> dict:
     rms = {"scale": P(None)}
     attn = {"q": col, "k": col, "v": col, "o": row}
     attn_nb = {"q": col_nb, "k": col_nb, "v": col_nb, "o": row_nb}
+    # Qwen2-family llama blocks: q/k/v carry biases (column-split with their
+    # matrices), o stays bias-free
+    attn_qkvb = {"q": col, "k": col, "v": col, "o": row_nb}
     if cfg.arch == "ref_decoder":
         return {"self_attn": attn, "cross_attn": attn, "ln1": ln, "ln2": ln,
                 "ln3": ln, "lin1": col, "lin2": row}
     if cfg.arch == "gpt2":
         return {"ln1": ln, "attn": attn, "ln2": ln, "lin1": col, "lin2": row}
     if cfg.arch == "llama":
-        return {"rms1": rms, "attn": attn_nb, "rms2": rms,
-                "w1": col_nb, "w2": row_nb, "w3": col_nb}
+        return {"rms1": rms,
+                "attn": attn_qkvb if cfg.attention_qkv_bias else attn_nb,
+                "rms2": rms, "w1": col_nb, "w2": row_nb, "w3": col_nb}
     raise ValueError(cfg.arch)
 
 

@@ -76,6 +76,9 @@ class ModelConfig:
     # Mistral-style sliding-window attention: each position attends to at
     # most this many preceding positions (None = full causal). llama arch.
     sliding_window: Optional[int] = None
+    # Qwen2-style attention biases: q/k/v projections carry biases while the
+    # output projection stays bias-free. llama arch only.
+    attention_qkv_bias: bool = False
 
     def __post_init__(self):
         if self.dim % self.n_heads != 0:
@@ -84,6 +87,10 @@ class ModelConfig:
             raise ValueError(f"n_heads={self.n_heads} must be divisible by n_kv_heads={self.n_kv_heads}")
         if self.arch not in ("ref_decoder", "gpt2", "llama"):
             raise ValueError(f"unknown arch {self.arch!r}")
+        if self.attention_qkv_bias and self.arch != "llama":
+            raise ValueError("attention_qkv_bias requires arch='llama' "
+                             "(Qwen2-family blocks; gpt2/ref biases are "
+                             "always on)")
         if self.sliding_window is not None:
             if self.arch != "llama":
                 raise ValueError("sliding_window requires arch='llama' "

@@ -1,0 +1,177 @@
+"""Qwen2 family: llama blocks + q/k/v biases (attention_qkv_bias).
+
+Parity bar mirrors tests/test_hf_import.py: tiny torch models built
+locally, copied weights, logits within ~1e-4.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.models import transformer as tfm
+from distributed_training_with_pipeline_parallelism_tpu.models.hf import from_hf, to_hf
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import make_mesh
+from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+    make_pipeline_step)
+
+
+def _tiny_qwen2(tie=False):
+    cfg = transformers.Qwen2Config(
+        vocab_size=211, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6, rope_theta=1e6,
+        tie_word_embeddings=tie, use_sliding_window=False)
+    with torch.no_grad():
+        return transformers.Qwen2ForCausalLM(cfg).eval()
+
+
+def _torch_logits(model, tokens):
+    with torch.no_grad():
+        return model(torch.from_numpy(np.asarray(tokens))).logits.numpy()
+
+
+@pytest.mark.parametrize("tie", [False, True], ids=["untied", "tied"])
+def test_qwen2_import_logits_parity(tie):
+    model = _tiny_qwen2(tie)
+    cfg, params = from_hf(model)
+    assert cfg.attention_qkv_bias and cfg.arch == "llama"
+    assert cfg.tie_embeddings == tie
+    assert "b" in params["layers"]["attn"]["q"]  # biases imported
+    assert "b" not in params["layers"]["attn"]["o"]
+    tokens = np.random.default_rng(0).integers(0, 211, (2, 17))
+    ours = np.asarray(tfm.transformer_apply(cfg, params, jnp.asarray(tokens)))
+    ref = _torch_logits(model, tokens)
+    assert np.allclose(ours, ref, atol=2e-4), np.abs(ours - ref).max()
+
+
+def test_qwen2_export_round_trip():
+    cfg = dtpp.ModelConfig(dim=48, n_layers=3, n_heads=4, n_kv_heads=2,
+                           vocab_size=211, ffn_dim=96, max_seq_len=64,
+                           arch="llama", attention_qkv_bias=True,
+                           rms_eps=1e-6, rope_theta=1e6)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    assert "b" in params["layers"]["attn"]["q"]
+    model = to_hf(cfg, params)
+    assert model.config.model_type == "qwen2"
+    cfg2, params2 = from_hf(model)
+    assert cfg2.attention_qkv_bias
+    same = jax.tree.map(
+        lambda a, b: bool(np.array_equal(np.asarray(a, np.float32),
+                                         np.asarray(b, np.float32))),
+        params, params2)
+    assert all(jax.tree.leaves(same))
+    tokens = np.random.default_rng(1).integers(0, 211, (2, 9))
+    ours = np.asarray(tfm.transformer_apply(cfg, params, jnp.asarray(tokens)))
+    ref = _torch_logits(model, tokens)
+    assert np.allclose(ours, ref, atol=2e-4)
+
+
+def test_qwen2_windowed_export_logits_parity():
+    """Windowed export must set max_window_layers=0 so HF actually windows
+    every layer (the HF default of 28 would silently disable the window)."""
+    cfg = dtpp.ModelConfig(dim=48, n_layers=3, n_heads=4, n_kv_heads=2,
+                           vocab_size=211, ffn_dim=96, max_seq_len=64,
+                           arch="llama", attention_qkv_bias=True,
+                           sliding_window=8, rms_eps=1e-6)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    model = to_hf(cfg, params)
+    assert model.config.max_window_layers == 0
+    assert set(model.config.layer_types) == {"sliding_attention"}
+    tokens = np.random.default_rng(0).integers(0, 211, (2, 17))
+    ours = np.asarray(tfm.transformer_apply(cfg, params, jnp.asarray(tokens)))
+    ref = _torch_logits(model, tokens)
+    assert np.allclose(ours, ref, atol=3e-4), np.abs(ours - ref).max()
+    cfg2, _ = from_hf(model)
+    assert cfg2.sliding_window == 8
+
+
+def test_qwen2_mixed_window_layers_refused():
+    cfg = transformers.Qwen2Config(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        use_sliding_window=True, sliding_window=8, max_window_layers=2)
+    with torch.no_grad():
+        model = transformers.Qwen2ForCausalLM(cfg).eval()
+    with pytest.raises(NotImplementedError, match="max_window_layers"):
+        from_hf(model)
+
+
+def test_llama_attention_bias_refused():
+    # Llama attention_bias=True puts a bias on o_proj too; importing would
+    # silently drop it
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        attention_bias=True)
+    with torch.no_grad():
+        model = transformers.LlamaForCausalLM(cfg).eval()
+    with pytest.raises(NotImplementedError, match="o_proj"):
+        from_hf(model)
+
+
+def test_qwen2_pipeline_matches_single_device():
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
+                           vocab_size=50, ffn_dim=64, max_seq_len=16,
+                           arch="llama", attention_qkv_bias=True)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 6), 0, 50)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(cfg, p, tokens, tokens))(params)
+    step = make_pipeline_step(
+        cfg, make_mesh(n_pipe=2),
+        dtpp.ScheduleConfig(name="1F1B", n_microbatches=4))
+    loss, grads = step(params, tokens, tokens)
+    assert float(jnp.abs(loss - ref_loss)) < 2e-5
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                       grads, ref_grads)
+    assert max(jax.tree.leaves(err)) < 2e-5
+
+
+def test_qwen2_pipeline_with_tensor_parallel():
+    # the q/k/v bias leaves must carry Megatron column-split specs
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
+                           vocab_size=50, ffn_dim=64, max_seq_len=16,
+                           arch="llama", attention_qkv_bias=True)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 6), 0, 50)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(cfg, p, tokens, tokens))(params)
+    step = make_pipeline_step(
+        cfg, make_mesh(n_pipe=2, n_model=2),
+        dtpp.ScheduleConfig(name="1F1B", n_microbatches=4))
+    loss, grads = step(params, tokens, tokens)
+    assert float(jnp.abs(loss - ref_loss)) < 2e-5
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                       grads, ref_grads)
+    assert max(jax.tree.leaves(err)) < 2e-5
+
+
+def test_qwen2_registry_and_guard():
+    from distributed_training_with_pipeline_parallelism_tpu.models.llama import (
+        llama_config)
+
+    cfg = llama_config("qwen2-0.5b")
+    assert cfg.attention_qkv_bias and cfg.tie_embeddings
+    assert (cfg.dim, cfg.n_layers) == (896, 24)
+    with pytest.raises(ValueError, match="attention_qkv_bias"):
+        dtpp.ModelConfig(attention_qkv_bias=True)  # ref_decoder arch
+
+
+def test_qwen2_generate():
+    from distributed_training_with_pipeline_parallelism_tpu.models.generate import (
+        generate)
+
+    model = _tiny_qwen2()
+    cfg, params = from_hf(model)
+    prompt = np.random.default_rng(2).integers(0, 211, (1, 5))
+    ours = generate(cfg, params, jnp.asarray(prompt), max_new_tokens=6)
+    with torch.no_grad():
+        theirs = model.generate(torch.from_numpy(prompt), max_new_tokens=6,
+                                do_sample=False)
+    np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
