@@ -14,17 +14,33 @@ from typing import Any, Optional
 Pytree = Any
 
 
+_SHARED = None
+
+
 def _checkpointer():
-    import orbax.checkpoint as ocp
-    return ocp.StandardCheckpointer()
+    # one shared checkpointer so async saves serialize against each other
+    # (and against restores) instead of racing
+    global _SHARED
+    if _SHARED is None:
+        import orbax.checkpoint as ocp
+        _SHARED = ocp.StandardCheckpointer()
+    return _SHARED
 
 
-def save_checkpoint(path: str, state: Pytree) -> None:
+def save_checkpoint(path: str, state: Pytree, wait: bool = True) -> None:
     """Save a pytree (params, or {'params': ..., 'opt_state': ...}) to
-    ``path`` (created; must not already contain a checkpoint)."""
+    ``path`` (created; must not already contain a checkpoint).
+
+    ``wait=False`` returns as soon as the on-device state is snapshotted and
+    lets Orbax write to disk in the background — training continues while
+    the previous checkpoint flushes (the next save/restore waits for it
+    first). The training loop uses this for periodic mid-run saves and
+    ``wait=True`` for the final one."""
     ckpt = _checkpointer()
+    ckpt.wait_until_finished()  # serialize with any in-flight async save
     ckpt.save(os.path.abspath(path), state)
-    ckpt.wait_until_finished()
+    if wait:
+        ckpt.wait_until_finished()
 
 
 def restore_checkpoint(path: str, template: Optional[Pytree] = None) -> Pytree:
@@ -33,6 +49,7 @@ def restore_checkpoint(path: str, template: Optional[Pytree] = None) -> Pytree:
     structure/dtypes/shardings; without it, orbax restores as saved."""
     import jax
     ckpt = _checkpointer()
+    ckpt.wait_until_finished()  # a prior async save must land first
     if template is not None:
         from jax.sharding import NamedSharding
 

@@ -289,10 +289,10 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
             if verbose:
                 print(f"resumed from {path} (step {n})", flush=True)
 
-    def _save(i):
+    def _save(i, wait=True):
         save_checkpoint(os.path.join(checkpoint_dir, f"step_{i}"),
                         {"params": params, "opt_state": opt_state,
-                         "step": jnp.asarray(i)})
+                         "step": jnp.asarray(i)}, wait=wait)
 
     # Per-step dropout keys fold the step index from one base key, so a
     # resumed run draws the same masks the uninterrupted run would have.
@@ -363,7 +363,7 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
             window_tokens = 0
         if (checkpoint_dir and checkpoint_every
                 and (i + 1) % checkpoint_every == 0 and i != num_steps - 1):
-            _save(i)
+            _save(i, wait=False)  # flush in the background; training continues
     if profiling:  # profile window ran past the last step
         jax.profiler.stop_trace()
     if eval_fn is not None and num_steps > start_step:

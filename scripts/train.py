@@ -57,6 +57,9 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--weight-decay", type=float, default=0.01)
+    ap.add_argument("--warmup-steps", type=int, default=100)
+    ap.add_argument("--max-grad-norm", type=float, default=1.0)
     ap.add_argument("--dropout", type=float, default=0.0,
                     help="train-mode dropout rate (torch's "
                          "TransformerDecoderLayer default is 0.1); masks are "
@@ -209,7 +212,10 @@ def main():
           f"model={args.tp}, seq={args.sp}, expert={args.ep}), "
           f"{args.schedule} M={args.microbatches} V={args.virtual}", flush=True)
 
-    optimizer = train.adamw(learning_rate=args.lr, total_steps=args.steps)
+    optimizer = train.adamw(
+        learning_rate=args.lr, weight_decay=args.weight_decay,
+        warmup_steps=args.warmup_steps, max_grad_norm=args.max_grad_norm,
+        total_steps=max(1, args.steps // args.grad_accum))
 
     def init_params(key):
         if moe is not None:
@@ -227,10 +233,16 @@ def main():
         if latest is not None:
             path = latest[1]
         if os.path.basename(os.path.normpath(path)).startswith("step_"):
-            # fit()-style full training state
+            # fit()-style full training state. The saved opt_state reflects
+            # fit's own wrapping: --grad-accum > 1 checkpoints a
+            # MultiStepsState, so the template must mirror it.
+            import optax
+            tmpl_opt = (optax.MultiSteps(optimizer,
+                                         every_k_schedule=args.grad_accum)
+                        if args.grad_accum > 1 else optimizer)
             state = restore_checkpoint(path, template={
                 "params": params_t,
-                "opt_state": jax.eval_shape(optimizer.init, params_t),
+                "opt_state": jax.eval_shape(tmpl_opt.init, params_t),
                 "step": jnp.asarray(0)})
             params = state["params"]
         else:  # bare params checkpoint (e.g. converted HF weights)
