@@ -107,13 +107,18 @@ def _forward_with_cache(cfg: ModelConfig, params: Pytree, cache: Pytree,
         raise ValueError(
             f"generation is undefined for arch {cfg.arch!r}: the reference "
             "block is non-causal with no positional encoding (SURVEY.md C2)")
-    from .transformer import compute_cast
+    from .transformer import compute_cast, embed_apply
     params = compute_cast(cfg, params)  # decode in the compute dtype too
     b, s = tokens.shape
-    h = embedding_apply(params["embed"]["tok"], tokens)
     if cfg.arch == "gpt2":
+        # inline: decode needs pos[offset:offset+s], not embed_apply's [:s]
+        h = embedding_apply(params["embed"]["tok"], tokens)
         pos = jax.lax.dynamic_slice_in_dim(params["embed"]["pos"], offset, s)
         h = h + pos
+    else:
+        # the training-path embed (incl. Gemma's sqrt(dim) scaling) — shared
+        # so decode cannot drift from train/eval
+        h = embed_apply(cfg, params["embed"], tokens)
     rope_slice = None
     if cfg.arch == "llama":
         angles = rope_frequencies(cfg.head_dim, cache["k"].shape[2],

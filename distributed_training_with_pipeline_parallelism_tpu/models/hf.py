@@ -131,12 +131,12 @@ def llama_config_from_hf(hf_config) -> ModelConfig:
         raise NotImplementedError(
             "mlp_bias=True checkpoints are not supported (gate/up/down "
             "projection biases would be dropped)")
+    # decoupled head_dim (Gemma, Mistral-Nemo-class): carried natively via
+    # head_dim_override
     hd = getattr(hf_config, "head_dim", None)
-    if hd and hd != hf_config.hidden_size // hf_config.num_attention_heads:
-        raise NotImplementedError(
-            f"decoupled head_dim={hd} != hidden_size/num_heads="
-            f"{hf_config.hidden_size // hf_config.num_attention_heads} "
-            f"(Mistral-Nemo-class checkpoints) is not supported")
+    override = (int(hd) if hd and
+                hd != hf_config.hidden_size // hf_config.num_attention_heads
+                else None)
     # Qwen2 always carries q/k/v biases (its config has no attention_bias
     # field) and no o bias. Llama's attention_bias=True puts a bias on
     # o_proj TOO — this framework's blocks have no o bias, so importing
@@ -168,6 +168,7 @@ def llama_config_from_hf(hf_config) -> ModelConfig:
         rope_scaling=rope_scaling,
         sliding_window=window,
         attention_qkv_bias=qkv_bias,
+        head_dim_override=override,
         rms_eps=float(hf_config.rms_norm_eps),
         tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)))
 
@@ -222,6 +223,36 @@ def _to_dtype(params: Pytree, cfg: ModelConfig) -> Pytree:
     return jax.tree.map(lambda x: jnp.asarray(x, dtype), params)
 
 
+def gemma_config_from_hf(hf_config) -> ModelConfig:
+    import dataclasses
+
+    act = getattr(hf_config, "hidden_activation", None) or getattr(
+        hf_config, "hidden_act", None)
+    if act not in ("gelu", "gelu_pytorch_tanh"):
+        raise NotImplementedError(
+            f"gemma hidden activation {act!r} is not supported (tanh-approx "
+            f"gelu == jax.nn.gelu's default is)")
+    base = llama_config_from_hf(hf_config)
+    return dataclasses.replace(
+        base,
+        head_dim_override=int(hf_config.head_dim),
+        mlp_act="gelu", embed_scale=True,
+        # Gemma ties unconditionally (PretrainedConfig default True carries
+        # through llama_config_from_hf's getattr already, but be explicit)
+        tie_embeddings=True)
+
+
+def gemma_params_from_hf(model_or_sd, cfg: ModelConfig) -> Pytree:
+    """Gemma stores RMSNorm weights in the ``(1 + w)`` parametrization; this
+    framework's norm multiplies by the stored scale directly, so the +1 is
+    folded in here (and unfolded on export) — zero runtime cost."""
+    params = llama_params_from_hf(model_or_sd, cfg)
+    for key in ("rms1", "rms2"):
+        params["layers"][key]["scale"] = params["layers"][key]["scale"] + 1.0
+    params["head"]["norm"]["scale"] = params["head"]["norm"]["scale"] + 1.0
+    return params
+
+
 _CONVERTERS = {
     "gpt2": (gpt2_config_from_hf, gpt2_params_from_hf),
     "llama": (llama_config_from_hf, llama_params_from_hf),
@@ -230,6 +261,9 @@ _CONVERTERS = {
     "mistral": (llama_config_from_hf, llama_params_from_hf),
     # Qwen2 = llama blocks + q/k/v biases (attention_qkv_bias)
     "qwen2": (llama_config_from_hf, llama_params_from_hf),
+    # Gemma = llama blocks + decoupled head_dim + GeGLU + scaled embeddings
+    # + (1+w) norms folded at conversion
+    "gemma": (gemma_config_from_hf, gemma_params_from_hf),
 }
 
 
@@ -358,9 +392,33 @@ def to_hf(cfg: ModelConfig, params: Pytree):
             num_attention_heads=cfg.n_heads,
             num_key_value_heads=cfg.n_kv_heads or cfg.n_heads,
             max_position_embeddings=cfg.max_seq_len,
+            head_dim=cfg.head_dim,
             rms_norm_eps=cfg.rms_eps, rope_theta=cfg.rope_theta,
             tie_word_embeddings=cfg.tie_embeddings)
-        if cfg.attention_qkv_bias:
+        if cfg.embed_scale:
+            # Gemma: GeGLU + scaled embeddings + (1+w) norms (unfolded
+            # below); loading/tying falls through to the shared tail
+            if (cfg.mlp_act != "gelu" or not cfg.tie_embeddings
+                    or cfg.rope_scaling is not None
+                    or cfg.sliding_window is not None
+                    or cfg.attention_qkv_bias):
+                raise NotImplementedError(
+                    "embed_scale exports as Gemma, which requires "
+                    "mlp_act='gelu', tied embeddings, plain RoPE, no "
+                    "window, no qkv bias")
+            hf_cfg = transformers.GemmaConfig(
+                hidden_activation="gelu_pytorch_tanh", **common)
+            model = transformers.GemmaForCausalLM(hf_cfg)
+            sd = llama_state_dict(cfg, params)
+            for k in list(sd):
+                if k.endswith("norm.weight") or "layernorm" in k:
+                    sd[k] = sd[k] - 1.0  # back to Gemma's (1 + w) storage
+        elif cfg.mlp_act != "silu":
+            raise NotImplementedError(
+                "mlp_act='gelu' without embed_scale has no HF model_type "
+                "(Llama/Mistral/Qwen2 are SwiGLU); exporting as SwiGLU "
+                "would silently change the MLP")
+        elif cfg.attention_qkv_bias:
             # Qwen2: llama blocks + always-on q/k/v biases
             if cfg.rope_scaling is not None:
                 raise NotImplementedError(
@@ -393,7 +451,8 @@ def to_hf(cfg: ModelConfig, params: Pytree):
             hf_cfg = transformers.LlamaConfig(
                 attention_bias=False, mlp_bias=False, **common)
             model = transformers.LlamaForCausalLM(hf_cfg)
-        sd = llama_state_dict(cfg, params)
+        if not cfg.embed_scale:  # the Gemma branch built (and re-folded) sd
+            sd = llama_state_dict(cfg, params)
     else:
         raise ValueError(
             f"arch {cfg.arch!r} has no HF equivalent (the ref_decoder block "

@@ -49,6 +49,18 @@ def llama_config(name: str = "llama2-7b", **overrides) -> ModelConfig:
                          ffn_dim=18944, vocab_size=152064, rope_theta=1e6,
                          max_seq_len=32768, attention_qkv_bias=True,
                          rms_eps=1e-6),
+        # Gemma 1: decoupled head_dim 256, GeGLU, scaled embeddings, tied,
+        # (1+w) norms folded at HF conversion; 2b is multi-query (kv=1)
+        "gemma-2b": dict(dim=2048, n_layers=18, n_heads=8, n_kv_heads=1,
+                         head_dim_override=256, ffn_dim=16384,
+                         vocab_size=256000, rope_theta=1e4, max_seq_len=8192,
+                         mlp_act="gelu", embed_scale=True,
+                         tie_embeddings=True, rms_eps=1e-6),
+        "gemma-7b": dict(dim=3072, n_layers=28, n_heads=16, n_kv_heads=16,
+                         head_dim_override=256, ffn_dim=24576,
+                         vocab_size=256000, rope_theta=1e4, max_seq_len=8192,
+                         mlp_act="gelu", embed_scale=True,
+                         tie_embeddings=True, rms_eps=1e-6),
         # scaled-down variant with the same shape ratios for tests/benches
         "llama-debug": dict(dim=256, n_layers=8, n_heads=8, n_kv_heads=4,
                             ffn_dim=688, vocab_size=1024, rope_theta=1e4),

@@ -234,6 +234,10 @@ def moe_lm_loss(cfg: ModelConfig, moe: MoEConfig, params: Dict,
             "tie_embeddings is not implemented for MoE models (moe_lm_init "
             "builds its own untied head); silently training untied would "
             "ignore the requested weight sharing")
+    if cfg.embed_scale:
+        raise NotImplementedError(
+            "embed_scale is not implemented for the MoE loss; mirror the "
+            "pipeline guard rather than silently skip the scaling")
     h = embedding_apply(params["embed"]["tok"], tokens)
     h = h + params["embed"]["pos"][: tokens.shape[1]]
     h = h.astype(jnp.dtype(cfg.dtype))

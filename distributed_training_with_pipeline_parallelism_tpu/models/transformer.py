@@ -63,7 +63,8 @@ def layer_init(key: jax.Array, cfg: ModelConfig) -> Dict:
         return {
             "rms1": rms_norm_init(cfg.dim),
             "attn": mha_init(ks[0], cfg.dim, cfg.n_heads, cfg.n_kv_heads,
-                             bias=cfg.attention_qkv_bias, o_bias=False),
+                             bias=cfg.attention_qkv_bias, o_bias=False,
+                             head_dim=cfg.head_dim),
             "rms2": rms_norm_init(cfg.dim),
             "w1": linear_init(ks[2], cfg.dim, cfg.ffn_dim, bias=False),
             "w2": linear_init(ks[3], cfg.ffn_dim, cfg.dim, bias=False),
@@ -158,8 +159,9 @@ def mlp_block(cfg: ModelConfig, params: Dict, h: jax.Array,
                       tp_axis)
         return h + dropout_apply(ff, dropout, rng)
     m = _tp_in(rms_norm_apply(params["rms2"], h, cfg.rms_eps), tp_axis)
+    act = jax.nn.silu if cfg.mlp_act == "silu" else jax.nn.gelu
     ff = _ffn_out(params["w2"],
-                  jax.nn.silu(linear_apply(params["w1"], m)) * linear_apply(params["w3"], m),
+                  act(linear_apply(params["w1"], m)) * linear_apply(params["w3"], m),
                   tp_axis)
     return h + dropout_apply(ff, dropout, rng)
 
@@ -213,6 +215,10 @@ def compute_cast(cfg: ModelConfig, tree: Dict) -> Dict:
 def embed_apply(cfg: ModelConfig, embed: Dict, tokens: jax.Array,
                 rng: Optional[jax.Array] = None) -> jax.Array:
     h = embedding_apply(embed["tok"], tokens)
+    if cfg.embed_scale:
+        # Gemma scales embedding OUTPUTS by sqrt(dim); the tied head keeps
+        # the unscaled table, so this cannot fold into the weights
+        h = h * (cfg.dim ** 0.5)
     if cfg.arch == "gpt2":
         h = h + embed["pos"][: tokens.shape[1]]
         h = dropout_apply(h, cfg.dropout, rng)  # GPT-2 embedding dropout

@@ -21,11 +21,14 @@ from .layers import dropout_apply, linear_init, linear_apply
 
 
 def mha_init(key: jax.Array, dim: int, n_heads: int, n_kv_heads: Optional[int] = None,
-             bias: bool = True, o_bias: Optional[bool] = None) -> Dict:
+             bias: bool = True, o_bias: Optional[bool] = None,
+             head_dim: Optional[int] = None) -> Dict:
     """``bias`` covers q/k/v; ``o_bias`` the output projection (defaults to
-    ``bias`` — Qwen2-family blocks set bias=True, o_bias=False)."""
+    ``bias`` — Qwen2-family blocks set bias=True, o_bias=False).
+    ``head_dim`` decouples per-head width from ``dim // n_heads``
+    (Gemma-family blocks)."""
     n_kv_heads = n_kv_heads or n_heads
-    head_dim = dim // n_heads
+    head_dim = head_dim or dim // n_heads
     kq, kk, kv, ko = jax.random.split(key, 4)
     return {
         "q": linear_init(kq, dim, n_heads * head_dim, bias=bias),

@@ -211,6 +211,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         raise NotImplementedError(
             "dropout currently composes with dense data x pipe meshes; "
             "model/seq/expert axes would need axis-aware mask folding")
+    if n_seq > 1 and (cfg.embed_scale or cfg.mlp_act != "silu"):
+        raise NotImplementedError(
+            "Gemma-family knobs (embed_scale / gelu-gated MLP) are not "
+            "implemented in the seq-parallel stage body")
     if cfg.tie_embeddings and (moe is not None or tp_vocab_parallel):
         raise NotImplementedError(
             "tie_embeddings composes with dense stages and the replicated "

@@ -144,6 +144,10 @@ def make_sp_loss_fn(cfg: ModelConfig, mesh: Mesh, attn_impl: str = "ring",
             "tie_embeddings is not implemented for the seq-parallel loss "
             "(the tied head needs the embedding threaded into the "
             "last-stage objective)")
+    if cfg.embed_scale or cfg.mlp_act != "silu":
+        raise NotImplementedError(
+            "Gemma-family knobs (embed_scale / gelu-gated MLP) are not "
+            "implemented in the seq-parallel stage body")
     D = mesh.shape[SEQ_AXIS]
 
     def spmd_loss(params, tokens, targets):

@@ -79,6 +79,18 @@ class ModelConfig:
     # Qwen2-style attention biases: q/k/v projections carry biases while the
     # output projection stays bias-free. llama arch only.
     attention_qkv_bias: bool = False
+    # Gemma-family knobs (llama arch only):
+    # - head_dim_override: decouple per-head width from dim/n_heads
+    #   (Gemma: 256 regardless of dim); None = dim // n_heads.
+    # - mlp_act: the gated MLP's gate activation — "silu" (Llama SwiGLU) or
+    #   "gelu" (Gemma GeGLU, tanh approximation).
+    # - embed_scale: multiply embedding OUTPUTS by sqrt(dim) (the tied head
+    #   keeps the unscaled table, so this cannot fold into the weights).
+    # Gemma's (1 + w) RMSNorm parametrization needs no knob: the +1 is
+    # folded into the stored scale at HF import/export (models/hf.py).
+    head_dim_override: Optional[int] = None
+    mlp_act: str = "silu"
+    embed_scale: bool = False
 
     def __post_init__(self):
         if self.dim % self.n_heads != 0:
@@ -91,6 +103,15 @@ class ModelConfig:
             raise ValueError("attention_qkv_bias requires arch='llama' "
                              "(Qwen2-family blocks; gpt2/ref biases are "
                              "always on)")
+        if self.mlp_act not in ("silu", "gelu"):
+            raise ValueError(f"mlp_act={self.mlp_act!r} must be 'silu' or "
+                             f"'gelu'")
+        if ((self.head_dim_override is not None or self.mlp_act != "silu"
+             or self.embed_scale) and self.arch != "llama"):
+            raise ValueError("head_dim_override / mlp_act / embed_scale are "
+                             "Gemma-family knobs on arch='llama' blocks")
+        if self.head_dim_override is not None and self.head_dim_override < 1:
+            raise ValueError(f"head_dim_override={self.head_dim_override}")
         if self.sliding_window is not None:
             if self.arch != "llama":
                 raise ValueError("sliding_window requires arch='llama' "
@@ -122,6 +143,8 @@ class ModelConfig:
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         assert self.dim % self.n_heads == 0
         return self.dim // self.n_heads
 

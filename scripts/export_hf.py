@@ -46,7 +46,7 @@ def main():
     def build_cfg(**overrides):
         if args.model.startswith("gpt2-"):
             return gpt2_config(args.model.removeprefix("gpt2-"), **overrides)
-        if args.model.startswith(("llama", "mistral")):
+        if args.model.startswith(("llama", "mistral", "qwen2", "gemma")):
             return llama_config(args.model, **overrides)
         raise SystemExit(f"unknown model {args.model} (ref_decoder has no "
                          f"HF equivalent)")

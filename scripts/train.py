@@ -164,7 +164,7 @@ def main():
     def build_cfg(**overrides):
         if args.model.startswith("gpt2-"):
             return gpt2_config(args.model.removeprefix("gpt2-"), **overrides)
-        if args.model.startswith("llama"):
+        if args.model.startswith(("llama", "mistral", "qwen2", "gemma")):
             return llama_config(args.model, **overrides)
         if args.model == "ref":
             return dtpp.ModelConfig(**overrides)

@@ -1,0 +1,136 @@
+"""Gemma family: decoupled head_dim, GeGLU, scaled embeddings, (1+w) norms.
+
+Parity bar mirrors tests/test_qwen2.py: tiny torch models built locally,
+copied weights, logits within ~1e-4 (the norm fold and embed scale are
+exact transformations, so any looseness here would be a conversion bug).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.models import transformer as tfm
+from distributed_training_with_pipeline_parallelism_tpu.models.hf import from_hf, to_hf
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import make_mesh
+from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+    make_pipeline_step)
+
+
+def _tiny_gemma():
+    cfg = transformers.GemmaConfig(
+        vocab_size=211, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        rope_theta=1e4, hidden_activation="gelu_pytorch_tanh")
+    with torch.no_grad():
+        return transformers.GemmaForCausalLM(cfg).eval()
+
+
+def _torch_logits(model, tokens):
+    with torch.no_grad():
+        return model(torch.from_numpy(np.asarray(tokens))).logits.numpy()
+
+
+def test_gemma_import_logits_parity():
+    model = _tiny_gemma()
+    cfg, params = from_hf(model)
+    assert cfg.head_dim == 16 and cfg.head_dim != cfg.dim // cfg.n_heads
+    assert cfg.mlp_act == "gelu" and cfg.embed_scale and cfg.tie_embeddings
+    assert "out" not in params["head"]
+    tokens = np.random.default_rng(0).integers(0, 211, (2, 17))
+    ours = np.asarray(tfm.transformer_apply(cfg, params, jnp.asarray(tokens)))
+    ref = _torch_logits(model, tokens)
+    assert np.allclose(ours, ref, atol=3e-4), np.abs(ours - ref).max()
+
+
+def test_gemma_export_round_trip():
+    from distributed_training_with_pipeline_parallelism_tpu.models.llama import (
+        llama_config)
+
+    cfg = llama_config("gemma-2b", dim=48, n_layers=3, n_heads=4,
+                       n_kv_heads=1, head_dim_override=16, ffn_dim=96,
+                       vocab_size=211, max_seq_len=64)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    model = to_hf(cfg, params)
+    assert model.config.model_type == "gemma"
+    cfg2, params2 = from_hf(model)
+    assert cfg2.embed_scale and cfg2.head_dim == 16
+    same = jax.tree.map(
+        lambda a, b: bool(np.allclose(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32), atol=1e-6)),
+        params, params2)
+    assert all(jax.tree.leaves(same))
+    tokens = np.random.default_rng(1).integers(0, 211, (2, 9))
+    ours = np.asarray(tfm.transformer_apply(cfg, params, jnp.asarray(tokens)))
+    ref = _torch_logits(model, tokens)
+    assert np.allclose(ours, ref, atol=3e-4), np.abs(ours - ref).max()
+
+
+def test_gemma_pipeline_matches_single_device():
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, n_kv_heads=1,
+                           vocab_size=50, ffn_dim=64, max_seq_len=16,
+                           arch="llama", head_dim_override=16,
+                           mlp_act="gelu", embed_scale=True,
+                           tie_embeddings=True, rms_eps=1e-6)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 6), 0, 50)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(cfg, p, tokens, tokens))(params)
+    step = make_pipeline_step(
+        cfg, make_mesh(n_pipe=2),
+        dtpp.ScheduleConfig(name="1F1B", n_microbatches=4))
+    loss, grads = step(params, tokens, tokens)
+    assert float(jnp.abs(loss - ref_loss)) < 2e-5
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                       grads, ref_grads)
+    assert max(jax.tree.leaves(err)) < 2e-5
+
+
+def test_gemma_generate_matches_hf():
+    from distributed_training_with_pipeline_parallelism_tpu.models.generate import (
+        generate)
+
+    model = _tiny_gemma()
+    cfg, params = from_hf(model)
+    prompt = np.random.default_rng(2).integers(0, 211, (1, 5))
+    ours = generate(cfg, params, jnp.asarray(prompt), max_new_tokens=6)
+    with torch.no_grad():
+        theirs = model.generate(torch.from_numpy(prompt), max_new_tokens=6,
+                                do_sample=False)
+    np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
+
+
+def test_gemma_registry_and_guards():
+    from distributed_training_with_pipeline_parallelism_tpu.models.llama import (
+        llama_config)
+
+    cfg = llama_config("gemma-2b")
+    assert cfg.head_dim == 256 and cfg.n_kv_heads == 1  # multi-query
+    assert cfg.mlp_act == "gelu" and cfg.embed_scale and cfg.tie_embeddings
+    with pytest.raises(ValueError, match="Gemma-family"):
+        dtpp.ModelConfig(embed_scale=True)  # ref_decoder arch
+    with pytest.raises(ValueError, match="mlp_act"):
+        dtpp.ModelConfig(arch="llama", mlp_act="relu")
+
+
+def test_mistral_nemo_class_head_dim_imports():
+    """Decoupled head_dim on plain Llama checkpoints (Mistral-Nemo-class)
+    now imports via head_dim_override instead of being refused."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=97, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, attention_bias=False, tie_word_embeddings=False)
+    with torch.no_grad():
+        model = transformers.LlamaForCausalLM(cfg).eval()
+    c, params = from_hf(model)
+    assert c.head_dim == 16
+    tokens = np.random.default_rng(3).integers(0, 97, (2, 7))
+    ours = np.asarray(tfm.transformer_apply(c, params, jnp.asarray(tokens)))
+    ref = _torch_logits(model, tokens)
+    assert np.allclose(ours, ref, atol=2e-4), np.abs(ours - ref).max()
