@@ -247,9 +247,17 @@ def gemma_params_from_hf(model_or_sd, cfg: ModelConfig) -> Pytree:
     framework's norm multiplies by the stored scale directly, so the +1 is
     folded in here (and unfolded on export) — zero runtime cost."""
     params = llama_params_from_hf(model_or_sd, cfg)
+
+    def fold(s):
+        # +1 in float32 BEFORE the storage-dtype cast: HF's GemmaRMSNorm
+        # computes (1 + w.float()), so folding after a bf16 cast would
+        # round every effective scale
+        return (jnp.asarray(s, jnp.float32)
+                + 1.0).astype(jnp.dtype(cfg.storage_dtype))
+
     for key in ("rms1", "rms2"):
-        params["layers"][key]["scale"] = params["layers"][key]["scale"] + 1.0
-    params["head"]["norm"]["scale"] = params["head"]["norm"]["scale"] + 1.0
+        params["layers"][key]["scale"] = fold(params["layers"][key]["scale"])
+    params["head"]["norm"]["scale"] = fold(params["head"]["norm"]["scale"])
     return params
 
 

@@ -85,12 +85,13 @@ def tp_output_projection(o_params, out, tp_axis):
     return row_parallel_linear(o_params, out, tp_axis)
 
 
-def vocab_parallel_xent(logits_local: jax.Array, targets: jax.Array,
+def _vocab_parallel_nll(logits_local: jax.Array, targets: jax.Array,
                         axis_name: str) -> jax.Array:
-    """Mean token-wise cross entropy over a vocab-sharded logits tensor
-    (Megatron parallel cross-entropy, arXiv:1909.08053 §3): each device
-    holds a contiguous vocab slice ``[my*Vl, (my+1)*Vl)`` of the logits
-    ``[..., V_local]``; the full ``[..., V]`` tensor never materializes.
+    """Per-position NLL over a vocab-sharded logits tensor (Megatron
+    parallel cross-entropy, arXiv:1909.08053 §3): each device holds a
+    contiguous vocab slice ``[my*Vl, (my+1)*Vl)``; the full ``[..., V]``
+    tensor never materializes. Shared core of the mean and ignore-index
+    variants so their collective numerics cannot drift.
 
     The max for numerical stability is a stop-gradient pmax; logsumexp and
     the target logit each take one psum over ``axis_name``. Differentiable
@@ -118,4 +119,26 @@ def vocab_parallel_xent(logits_local: jax.Array, targets: jax.Array,
     tl_part = jnp.take_along_axis(
         x, jnp.clip(local_t, 0, v_local - 1)[..., None], axis=-1)[..., 0]
     tl = tp_reduce(jnp.where(hit, tl_part, 0.0), axis_name)
-    return jnp.mean(lse - tl)
+    return lse - tl
+
+
+def vocab_parallel_xent(logits_local: jax.Array, targets: jax.Array,
+                        axis_name: str) -> jax.Array:
+    """Mean token-wise cross entropy via :func:`_vocab_parallel_nll`."""
+    import jax.numpy as jnp
+
+    return jnp.mean(_vocab_parallel_nll(logits_local, targets, axis_name))
+
+
+def vocab_parallel_masked_xent_sum(logits_local: jax.Array,
+                                   targets: jax.Array, axis_name: str,
+                                   pad_id: int):
+    """Ignore-index twin of :func:`vocab_parallel_xent`: NLL SUM over
+    non-pad positions plus the valid count. Same (sum, count) contract as
+    ``ops.layers.masked_xent_sum`` so the pipeline's global-valid-count
+    normalization applies unchanged."""
+    import jax.numpy as jnp
+
+    nll = _vocab_parallel_nll(logits_local, targets, axis_name)
+    valid = targets != pad_id
+    return jnp.sum(jnp.where(valid, nll, 0.0)), jnp.sum(valid)

@@ -221,12 +221,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             "head (MoE keeps its own head; the vocab-parallel CE would "
             "need an embed-sharded variant)")
     if cfg.pad_token_id is not None and (
-            moe is not None or n_seq > 1 or n_ep > 1 or tp_vocab_parallel):
+            moe is not None or n_seq > 1 or n_ep > 1):
         raise NotImplementedError(
             "pad_token_id loss masking composes with data x pipe x model "
-            "meshes (replicated-logits loss); seq/expert sharding and the "
-            "vocab-parallel CE would need masked variants of their "
-            "reductions")
+            "meshes (replicated-logits or vocab-parallel loss); seq/expert "
+            "sharding would need masked variants of their reductions")
     if moe is not None:
         if T > 1 or n_seq > 1:
             raise NotImplementedError(
@@ -394,12 +393,20 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 if tp_vocab_parallel:
                     # Megatron parallel CE: head matmul column-split over
                     # 'model'; the [mb, s, V] logits never materialize.
-                    from ..ops.collectives import tp_copy, vocab_parallel_xent
+                    from ..ops.collectives import (
+                        tp_copy, vocab_parallel_masked_xent_sum,
+                        vocab_parallel_xent)
                     yn = head_norm_apply(cfg, head_p, y)
                     logits_local = linear_apply(head_p["out"],
                                                 tp_copy(yn, tp_axis))
-                    local = vocab_parallel_xent(logits_local, targets_mb[mm],
-                                                tp_axis)
+                    if cfg.pad_token_id is not None:
+                        s, _ = vocab_parallel_masked_xent_sum(
+                            logits_local, targets_mb[mm], tp_axis,
+                            cfg.pad_token_id)
+                        local = s * pad_scale
+                    else:
+                        local = vocab_parallel_xent(
+                            logits_local, targets_mb[mm], tp_axis)
                 elif cfg.pad_token_id is not None:
                     s, _ = select_masked_xent_sum(cfg.use_fused_xent)(
                         head_apply(cfg, head_p, y, embed=embed_p),

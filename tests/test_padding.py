@@ -74,6 +74,22 @@ def test_pipeline_masked_matches_single_device(problem, name, D, n_data, V, M):
     assert max(jax.tree.leaves(err)) < 1e-5
 
 
+def test_vocab_parallel_masked_matches_single_device(problem):
+    """pad masking through the Megatron parallel CE (vocab-sharded head)."""
+    params, tokens, targets = problem
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(CFG, p, tokens, targets))(params)
+    step = make_pipeline_step(
+        CFG, make_mesh(n_pipe=2, n_model=2),
+        dtpp.ScheduleConfig(name="1F1B", n_microbatches=4),
+        tp_vocab_parallel=True)
+    loss, grads = step(params, tokens, targets)
+    assert float(jnp.abs(loss - ref_loss)) < 1e-5
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                       grads, ref_grads)
+    assert max(jax.tree.leaves(err)) < 1e-5
+
+
 def test_eval_loss_masked(problem):
     params, tokens, targets = problem
     ref = float(tfm.transformer_loss(CFG, params, tokens, targets))
