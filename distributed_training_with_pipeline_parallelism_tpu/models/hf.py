@@ -173,9 +173,15 @@ def llama_config_from_hf(hf_config) -> ModelConfig:
         tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)))
 
 
-def llama_params_from_hf(model_or_sd, cfg: ModelConfig) -> Pytree:
+def llama_params_from_hf(model_or_sd, cfg: ModelConfig,
+                         norm_offset: float = 0.0) -> Pytree:
+    """``norm_offset`` is added to every RMSNorm scale IN FLOAT32, before
+    any dtype cast — Gemma's (1 + w) parametrization folds in exactly."""
     sd = _state_dict(model_or_sd)
     pre = "model." if any(k.startswith("model.") for k in sd) else ""
+
+    def norm(name):
+        return {"scale": sd[name].astype(np.float32) + norm_offset}
 
     def lin_t(name, bias=False):  # torch nn.Linear [out, in] -> [in, out]
         p = {"w": sd[name + ".weight"].T}
@@ -188,19 +194,19 @@ def llama_params_from_hf(model_or_sd, cfg: ModelConfig) -> Pytree:
     def layer(i):
         p = f"{pre}layers.{i}."
         return {
-            "rms1": {"scale": sd[p + "input_layernorm.weight"]},
+            "rms1": norm(p + "input_layernorm.weight"),
             "attn": {"q": lin_t(p + "self_attn.q_proj", qkv_bias),
                      "k": lin_t(p + "self_attn.k_proj", qkv_bias),
                      "v": lin_t(p + "self_attn.v_proj", qkv_bias),
                      "o": lin_t(p + "self_attn.o_proj")},
-            "rms2": {"scale": sd[p + "post_attention_layernorm.weight"]},
+            "rms2": norm(p + "post_attention_layernorm.weight"),
             "w1": lin_t(p + "mlp.gate_proj"),
             "w2": lin_t(p + "mlp.down_proj"),
             "w3": lin_t(p + "mlp.up_proj"),
         }
 
     embed = sd[pre + "embed_tokens.weight"]
-    head = {"norm": {"scale": sd[pre + "norm.weight"]}}
+    head = {"norm": norm(pre + "norm.weight")}
     if not cfg.tie_embeddings:
         head["out"] = {"w": sd["lm_head.weight"].T if "lm_head.weight" in sd
                        else embed.T}  # materialize a tied source untied
@@ -219,7 +225,9 @@ def llama_params_from_hf(model_or_sd, cfg: ModelConfig) -> Pytree:
 
 def _to_dtype(params: Pytree, cfg: ModelConfig) -> Pytree:
     import jax
-    dtype = jnp.dtype(cfg.dtype)
+    # storage dtype: under mixed precision (param_dtype='float32') imported
+    # weights are the fp32 masters, matching transformer_init
+    dtype = jnp.dtype(cfg.storage_dtype)
     return jax.tree.map(lambda x: jnp.asarray(x, dtype), params)
 
 
@@ -245,20 +253,9 @@ def gemma_config_from_hf(hf_config) -> ModelConfig:
 def gemma_params_from_hf(model_or_sd, cfg: ModelConfig) -> Pytree:
     """Gemma stores RMSNorm weights in the ``(1 + w)`` parametrization; this
     framework's norm multiplies by the stored scale directly, so the +1 is
-    folded in here (and unfolded on export) — zero runtime cost."""
-    params = llama_params_from_hf(model_or_sd, cfg)
-
-    def fold(s):
-        # +1 in float32 BEFORE the storage-dtype cast: HF's GemmaRMSNorm
-        # computes (1 + w.float()), so folding after a bf16 cast would
-        # round every effective scale
-        return (jnp.asarray(s, jnp.float32)
-                + 1.0).astype(jnp.dtype(cfg.storage_dtype))
-
-    for key in ("rms1", "rms2"):
-        params["layers"][key]["scale"] = fold(params["layers"][key]["scale"])
-    params["head"]["norm"]["scale"] = fold(params["head"]["norm"]["scale"])
-    return params
+    folded in (exactly, in float32, before any dtype cast) and unfolded on
+    export — zero runtime cost."""
+    return llama_params_from_hf(model_or_sd, cfg, norm_offset=1.0)
 
 
 _CONVERTERS = {
@@ -337,11 +334,13 @@ def gpt2_state_dict(cfg: ModelConfig, params: Pytree) -> Dict[str, np.ndarray]:
     return sd
 
 
-def llama_state_dict(cfg: ModelConfig, params: Pytree) -> Dict[str, np.ndarray]:
-    """Inverse of :func:`llama_params_from_hf` ([in, out] -> torch [out, in])."""
+def llama_state_dict(cfg: ModelConfig, params: Pytree,
+                     norm_offset: float = 0.0) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`llama_params_from_hf` ([in, out] -> torch [out, in]);
+    ``norm_offset`` is SUBTRACTED from RMSNorm scales (Gemma's (1+w))."""
     sd: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": _f32(params["embed"]["tok"]),
-        "model.norm.weight": _f32(params["head"]["norm"]["scale"]),
+        "model.norm.weight": _f32(params["head"]["norm"]["scale"]) - norm_offset,
     }
     if not cfg.tie_embeddings:
         sd["lm_head.weight"] = _f32(params["head"]["out"]["w"]).T
@@ -349,7 +348,8 @@ def llama_state_dict(cfg: ModelConfig, params: Pytree) -> Dict[str, np.ndarray]:
     for i in range(cfg.n_layers):
         p = f"model.layers.{i}."
         a = ly["attn"]
-        sd[p + "input_layernorm.weight"] = _f32(ly["rms1"]["scale"][i])
+        sd[p + "input_layernorm.weight"] = (_f32(ly["rms1"]["scale"][i])
+                                           - norm_offset)
         sd[p + "self_attn.q_proj.weight"] = _f32(a["q"]["w"][i]).T
         sd[p + "self_attn.k_proj.weight"] = _f32(a["k"]["w"][i]).T
         sd[p + "self_attn.v_proj.weight"] = _f32(a["v"]["w"][i]).T
@@ -358,7 +358,8 @@ def llama_state_dict(cfg: ModelConfig, params: Pytree) -> Dict[str, np.ndarray]:
             sd[p + "self_attn.q_proj.bias"] = _f32(a["q"]["b"][i])
             sd[p + "self_attn.k_proj.bias"] = _f32(a["k"]["b"][i])
             sd[p + "self_attn.v_proj.bias"] = _f32(a["v"]["b"][i])
-        sd[p + "post_attention_layernorm.weight"] = _f32(ly["rms2"]["scale"][i])
+        sd[p + "post_attention_layernorm.weight"] = (
+            _f32(ly["rms2"]["scale"][i]) - norm_offset)
         sd[p + "mlp.gate_proj.weight"] = _f32(ly["w1"]["w"][i]).T
         sd[p + "mlp.down_proj.weight"] = _f32(ly["w2"]["w"][i]).T
         sd[p + "mlp.up_proj.weight"] = _f32(ly["w3"]["w"][i]).T
@@ -417,10 +418,7 @@ def to_hf(cfg: ModelConfig, params: Pytree):
             hf_cfg = transformers.GemmaConfig(
                 hidden_activation="gelu_pytorch_tanh", **common)
             model = transformers.GemmaForCausalLM(hf_cfg)
-            sd = llama_state_dict(cfg, params)
-            for k in list(sd):
-                if k.endswith("norm.weight") or "layernorm" in k:
-                    sd[k] = sd[k] - 1.0  # back to Gemma's (1 + w) storage
+            sd = llama_state_dict(cfg, params, norm_offset=1.0)
         elif cfg.mlp_act != "silu":
             raise NotImplementedError(
                 "mlp_act='gelu' without embed_scale has no HF model_type "

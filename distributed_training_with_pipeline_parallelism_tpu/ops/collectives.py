@@ -19,6 +19,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.numpy as jnp
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
@@ -98,8 +99,6 @@ def _vocab_parallel_nll(logits_local: jax.Array, targets: jax.Array,
     w.r.t. ``logits_local`` (grouped collectives: safe inside schedule
     conds).
     """
-    import jax.numpy as jnp
-
     v_local = logits_local.shape[-1]
     my = jax.lax.axis_index(axis_name)
     x = logits_local.astype(jnp.float32)
@@ -125,8 +124,6 @@ def _vocab_parallel_nll(logits_local: jax.Array, targets: jax.Array,
 def vocab_parallel_xent(logits_local: jax.Array, targets: jax.Array,
                         axis_name: str) -> jax.Array:
     """Mean token-wise cross entropy via :func:`_vocab_parallel_nll`."""
-    import jax.numpy as jnp
-
     return jnp.mean(_vocab_parallel_nll(logits_local, targets, axis_name))
 
 
@@ -137,8 +134,6 @@ def vocab_parallel_masked_xent_sum(logits_local: jax.Array,
     non-pad positions plus the valid count. Same (sum, count) contract as
     ``ops.layers.masked_xent_sum`` so the pipeline's global-valid-count
     normalization applies unchanged."""
-    import jax.numpy as jnp
-
     nll = _vocab_parallel_nll(logits_local, targets, axis_name)
     valid = targets != pad_id
     return jnp.sum(jnp.where(valid, nll, 0.0)), jnp.sum(valid)

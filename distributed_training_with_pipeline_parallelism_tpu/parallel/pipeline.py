@@ -220,12 +220,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             "tie_embeddings composes with dense stages and the replicated "
             "head (MoE keeps its own head; the vocab-parallel CE would "
             "need an embed-sharded variant)")
-    if cfg.pad_token_id is not None and (
-            moe is not None or n_seq > 1 or n_ep > 1):
+    if cfg.pad_token_id is not None and (moe is not None or n_ep > 1):
         raise NotImplementedError(
             "pad_token_id loss masking composes with data x pipe x model "
-            "meshes (replicated-logits or vocab-parallel loss); seq/expert "
-            "sharding would need masked variants of their reductions")
+            "x seq meshes; the MoE/expert loss would need a masked variant "
+            "of its aux normalization")
     if moe is not None:
         if T > 1 or n_seq > 1:
             raise NotImplementedError(
@@ -368,9 +367,12 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                      if moe is not None else 0.0)
 
         if cfg.pad_token_id is not None:
+            # the scale absorbs the WHOLE normalization (incl. the seq-shard
+            # sum), so the pad branches below skip the /loss_norm division
             pad_scale = global_pad_scale(
                 targets, cfg.pad_token_id, M,
-                data_axis=DATA_AXIS if n_data > 1 else None)
+                data_axis=DATA_AXIS if n_data > 1 else None,
+                seq_axis=SEQ_AXIS if n_seq > 1 else None)
 
         def stage_objective(p_v, head_arg, x_in, vv, mm, last_stage, g_in):
             """-> (objective, loss_report). The objective's gradients are the
@@ -403,15 +405,14 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                         s, _ = vocab_parallel_masked_xent_sum(
                             logits_local, targets_mb[mm], tp_axis,
                             cfg.pad_token_id)
-                        local = s * pad_scale
-                    else:
-                        local = vocab_parallel_xent(
-                            logits_local, targets_mb[mm], tp_axis)
+                        return s * pad_scale  # scale absorbs loss_norm
+                    local = vocab_parallel_xent(
+                        logits_local, targets_mb[mm], tp_axis)
                 elif cfg.pad_token_id is not None:
                     s, _ = select_masked_xent_sum(cfg.use_fused_xent)(
                         head_apply(cfg, head_p, y, embed=embed_p),
                         targets_mb[mm], cfg.pad_token_id)
-                    local = s * pad_scale
+                    return s * pad_scale  # scale absorbs loss_norm
                 else:
                     local = select_xent(cfg.use_fused_xent)(
                         head_apply(cfg, head_p, y, embed=embed_p),

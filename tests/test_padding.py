@@ -90,6 +90,32 @@ def test_vocab_parallel_masked_matches_single_device(problem):
     assert max(jax.tree.leaves(err)) < 1e-5
 
 
+@pytest.mark.parametrize("attn_impl", ["ring", "ulysses"])
+def test_seq_parallel_masked_matches_single_device(attn_impl):
+    """pad masking with ring/Ulysses attention inside pipeline stages
+    (pp x sp): the valid count psums over the seq shards too."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=50,
+                           ffn_dim=64, max_seq_len=32, arch="gpt2",
+                           pad_token_id=PAD)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 1, 50)
+    targets = np.array(jax.random.randint(jax.random.key(2), (4, 16), 1, 50))
+    for i, keep in enumerate([5, 16, 9, 12]):  # pad spans BOTH seq shards
+        targets[i, keep:] = PAD
+    targets = jnp.asarray(targets)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(cfg, p, tokens, targets))(params)
+    step = make_pipeline_step(
+        cfg, make_mesh(n_pipe=2, n_seq=2),
+        dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
+        sp_attn_impl=attn_impl)
+    loss, grads = step(params, tokens, targets)
+    assert float(jnp.abs(loss - ref_loss)) < 2e-5
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                       grads, ref_grads)
+    assert max(jax.tree.leaves(err)) < 2e-5
+
+
 def test_eval_loss_masked(problem):
     params, tokens, targets = problem
     ref = float(tfm.transformer_loss(CFG, params, tokens, targets))
@@ -113,9 +139,16 @@ def test_all_pad_microbatch_is_finite(problem):
 
 
 def test_pad_guards():
-    with pytest.raises(NotImplementedError):
-        make_pipeline_step(CFG, make_mesh(n_pipe=2, n_seq=2),
-                           dtpp.ScheduleConfig(name="GPipe", n_microbatches=2))
+    # seq sharding is supported now; MoE stages still are not
+    from distributed_training_with_pipeline_parallelism_tpu.models.moe import (
+        MoEConfig)
+
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=50,
+                           ffn_dim=64, arch="gpt2", pad_token_id=PAD)
+    with pytest.raises(NotImplementedError, match="pad_token_id"):
+        make_pipeline_step(cfg, make_mesh(n_pipe=2),
+                           dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
+                           moe=MoEConfig(n_experts=4))
 
 
 def test_fused_masked_xent_matches_xla():
