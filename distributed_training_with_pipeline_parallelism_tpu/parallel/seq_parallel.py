@@ -136,9 +136,11 @@ def make_sp_loss_fn(cfg: ModelConfig, mesh: Mesh, attn_impl: str = "ring",
                          f"got {attn_impl!r}")
     if cfg.pad_token_id is not None:
         raise NotImplementedError(
-            "pad_token_id masking is not implemented for the seq-parallel "
-            "loss (its per-shard mean assumes every position counts); "
-            "mirror the pipeline guard rather than silently mis-normalize")
+            "pad_token_id masking is not implemented for the standalone "
+            "seq-parallel loss (its per-shard mean assumes every position "
+            "counts). The pipeline executor supports pad x sp — mirror its "
+            "global_pad_scale(seq_axis=...) normalization (masked sums "
+            "scaled by the seq-psummed valid count) to add it here")
     if cfg.tie_embeddings:
         raise NotImplementedError(
             "tie_embeddings is not implemented for the seq-parallel loss "

@@ -70,6 +70,28 @@ def test_pipeline_tied_grads_match_single_device(problem, name, D, n_data, V, M)
     assert max(jax.tree.leaves(err)) < 1e-5, err
 
 
+@pytest.mark.parametrize("attn_impl", ["ring", "ulysses"])
+def test_tied_with_seq_parallel(attn_impl):
+    """Tied head inside pp x sp: the head-matmul embed grads follow the
+    same seq-axis psum as the lookup grads."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=50,
+                           ffn_dim=64, max_seq_len=32, arch="gpt2",
+                           tie_embeddings=True)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, 50)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(cfg, p, tokens, tokens))(params)
+    step = make_pipeline_step(
+        cfg, make_mesh(n_pipe=2, n_seq=2),
+        dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
+        sp_attn_impl=attn_impl)
+    loss, grads = step(params, tokens, tokens)
+    assert float(jnp.abs(loss - ref_loss)) < 1e-5
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                       grads, ref_grads)
+    assert max(jax.tree.leaves(err)) < 1e-5
+
+
 def test_tied_eval_and_forward(problem):
     params, tokens, targets, ref_loss, _ = problem
     mesh = make_mesh(n_pipe=2)
