@@ -219,16 +219,11 @@ def moe_lm_init(key: jax.Array, cfg: ModelConfig, moe: MoEConfig) -> Dict:
     return params
 
 
-def moe_lm_loss(cfg: ModelConfig, moe: MoEConfig, params: Dict,
-                tokens: jax.Array, targets: jax.Array,
-                axis_name: Optional[str] = None) -> jax.Array:
-    """CE loss + mean per-layer aux loss. Differentiable; works unsharded
-    (``axis_name=None``) or inside the EP shard_map (tokens batch-sharded,
-    experts sharded — :func:`..parallel.expert_parallel.make_ep_loss_fn`)."""
-    if cfg.pad_token_id is not None:
-        raise NotImplementedError(
-            "pad_token_id masking is not implemented for the MoE loss; "
-            "mirror the pipeline guard rather than silently mis-normalize")
+def moe_lm_logits_aux(cfg: ModelConfig, moe: MoEConfig, params: Dict,
+                      tokens: jax.Array,
+                      axis_name: Optional[str] = None):
+    """MoE LM forward: -> (logits [B, S, V], summed per-layer aux loss).
+    The shared core of :func:`moe_lm_loss` and test oracles."""
     if cfg.tie_embeddings:
         raise NotImplementedError(
             "tie_embeddings is not implemented for MoE models (moe_lm_init "
@@ -251,8 +246,33 @@ def moe_lm_loss(cfg: ModelConfig, moe: MoEConfig, params: Dict,
                                params["layers"])
     logits = linear_apply(params["head"]["out"],
                           layer_norm_apply(params["head"]["norm"], h))
-    loss = (select_xent(cfg.use_fused_xent)(logits, targets)
-            + moe.aux_loss_weight * aux / cfg.n_layers)
+    return logits, aux
+
+
+def moe_lm_loss(cfg: ModelConfig, moe: MoEConfig, params: Dict,
+                tokens: jax.Array, targets: jax.Array,
+                axis_name: Optional[str] = None) -> jax.Array:
+    """CE loss + mean per-layer aux loss. Differentiable; works unsharded
+    (``axis_name=None``) or inside the EP shard_map (tokens batch-sharded,
+    experts sharded — :func:`..parallel.expert_parallel.make_ep_loss_fn`).
+
+    With ``cfg.pad_token_id`` the CE normalizes by the (axis-global) valid
+    count; the routing aux loss stays token-uniform (pad positions are
+    routed and occupy expert capacity, so load balance legitimately counts
+    them)."""
+    logits, aux = moe_lm_logits_aux(cfg, moe, params, tokens, axis_name)
+    aux_term = moe.aux_loss_weight * aux / cfg.n_layers
+    if cfg.pad_token_id is not None:
+        from ..ops.layers import select_masked_xent_sum
+        s, n = select_masked_xent_sum(cfg.use_fused_xent)(
+            logits, targets, cfg.pad_token_id)
+        if axis_name is not None:
+            s = jax.lax.psum(s, axis_name)
+            n = jax.lax.psum(n, axis_name)
+            aux_term = (jax.lax.psum(aux_term, axis_name)
+                        / jax.lax.psum(1, axis_name))
+        return s / jnp.maximum(n, 1) + aux_term
+    loss = select_xent(cfg.use_fused_xent)(logits, targets) + aux_term
     if axis_name is not None:
         loss = jax.lax.psum(loss, axis_name) / jax.lax.psum(1, axis_name)
     return loss

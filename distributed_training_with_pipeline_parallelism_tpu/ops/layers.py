@@ -105,22 +105,24 @@ def masked_xent_sum(logits: jax.Array, targets: jax.Array,
 
 
 def global_pad_scale(targets: jax.Array, pad_id: int, n_micro: int,
-                     data_axis=None, seq_axis=None) -> jax.Array:
+                     data_axis=None, shard_axes=None) -> jax.Array:
     """The factor that turns per-microbatch masked NLL sums into the
     globally normalized ignore-index mean under the pipeline executor's
     standard reductions: the executor later multiplies accumulated loss by
-    ``1/n_micro`` and means over ``data_axis`` replicas (``seq_axis``
-    shards are summed unscaled), so pre-multiplying each sum by
-    ``n_micro * n_data / n_valid_global`` cancels everything into
-    ``total_nll / global_valid_count``. The valid count psums over BOTH
-    sharded axes. Must be called OUTSIDE the schedule scan."""
+    ``1/n_micro`` and means over ``data_axis`` replicas (``shard_axes`` —
+    an axis name or tuple of them, e.g. seq/expert — are summed unscaled),
+    so pre-multiplying each sum by ``n_micro * n_data / n_valid_global``
+    cancels everything into ``total_nll / global_valid_count``. The valid
+    count psums over every given axis. Must be called OUTSIDE the schedule
+    scan."""
     n_valid = jnp.sum(targets != pad_id).astype(jnp.float32)
     n_data = 1
     if data_axis is not None:
         n_valid = jax.lax.psum(n_valid, data_axis)
         n_data = jax.lax.axis_size(data_axis)
-    if seq_axis is not None:
-        n_valid = jax.lax.psum(n_valid, seq_axis)
+    axes = (shard_axes,) if isinstance(shard_axes, str) else (shard_axes or ())
+    for axis in axes:
+        n_valid = jax.lax.psum(n_valid, axis)
     return n_micro * n_data / jnp.maximum(n_valid, 1.0)
 
 

@@ -220,11 +220,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             "tie_embeddings composes with dense stages and the replicated "
             "head (MoE keeps its own head; the vocab-parallel CE would "
             "need an embed-sharded variant)")
-    if cfg.pad_token_id is not None and (moe is not None or n_ep > 1):
-        raise NotImplementedError(
-            "pad_token_id loss masking composes with data x pipe x model "
-            "x seq meshes; the MoE/expert loss would need a masked variant "
-            "of its aux normalization")
+    # pad masking composes with every supported mesh, including MoE/expert
+    # stages: the CE is globally valid-count normalized while the routing
+    # aux loss stays token-uniform (routing happens for pad positions too —
+    # they occupy expert capacity, so load balance legitimately counts them)
     if moe is not None:
         if T > 1 or n_seq > 1:
             raise NotImplementedError(
@@ -367,12 +366,15 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                      if moe is not None else 0.0)
 
         if cfg.pad_token_id is not None:
-            # the scale absorbs the WHOLE normalization (incl. the seq-shard
-            # sum), so the pad branches below skip the /loss_norm division
+            # the scale absorbs the WHOLE normalization (incl. the seq- and
+            # expert-shard sums), so the pad branches below skip /loss_norm
+            shard_axes = tuple(
+                ax for ax, n in ((SEQ_AXIS, n_seq), (EXPERT_AXIS, n_ep))
+                if n > 1)
             pad_scale = global_pad_scale(
                 targets, cfg.pad_token_id, M,
                 data_axis=DATA_AXIS if n_data > 1 else None,
-                seq_axis=SEQ_AXIS if n_seq > 1 else None)
+                shard_axes=shard_axes or None)
 
         def stage_objective(p_v, head_arg, x_in, vv, mm, last_stage, g_in):
             """-> (objective, loss_report). The objective's gradients are the
