@@ -20,14 +20,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: the suite is compile-dominated (hundreds of
-# tiny jitted programs); re-runs hit the cache and finish in a fraction of
-# the cold time. Keyed by HLO hash, so code changes invalidate safely.
-# User-scoped path: a world-shared fixed dir breaks on multi-user machines
-# (first user owns it; everyone else's writes fail silently). getuid, not
-# getpass: containers with arbitrary UIDs may have no passwd/env user at all.
-_uid = os.getuid() if hasattr(os, "getuid") else "na"
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(tempfile.gettempdir(), f"dtpp_jax_cache_{_uid}"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# NO persistent compilation cache for the suite. It was tried (user- and
+# CPU-feature-scoped dirs) and saved ~9 min on warm re-runs, but XLA:CPU
+# executable (de)serialization crashed the interpreter mid-suite twice —
+# SIGSEGV in compilation_cache.get_executable_and_time on one run, SIGABRT
+# in put_executable_and_time on a fresh cache dir the next — only under
+# full-suite write volume (the same test passes alone). A reliably green
+# ~20-minute suite beats an intermittently segfaulting 11-minute one.
+# (The "XLA:CPU AOT ... machine feature not supported on the host" warnings
+# on this virtualized host are the contributing smell: visible CPU features
+# differ between compile and load.)
+if "tempfile" in dir():  # keep the import satisfied for future use
+    pass
