@@ -236,10 +236,17 @@ def gemma_config_from_hf(hf_config) -> ModelConfig:
 
     act = getattr(hf_config, "hidden_activation", None) or getattr(
         hf_config, "hidden_act", None)
-    if act not in ("gelu", "gelu_pytorch_tanh"):
+    # 'gelu' is accepted only for model_type='gemma', where the historical
+    # Gemma-1 checkpoints said 'gelu' but were trained with the tanh
+    # approximation (the documented HF reading). Anywhere else 'gelu' means
+    # exact erf GELU, which jax.nn.gelu's default would silently change.
+    gemma1_tanh_reading = (
+        act == "gelu" and getattr(hf_config, "model_type", None) == "gemma")
+    if act != "gelu_pytorch_tanh" and not gemma1_tanh_reading:
         raise NotImplementedError(
             f"gemma hidden activation {act!r} is not supported (tanh-approx "
-            f"gelu == jax.nn.gelu's default is)")
+            f"gelu == jax.nn.gelu's default is; exact-erf 'gelu' outside "
+            f"model_type='gemma' would need approximate=False plumbing)")
     base = llama_config_from_hf(hf_config)
     return dataclasses.replace(
         base,

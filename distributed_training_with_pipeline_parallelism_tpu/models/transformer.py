@@ -88,7 +88,7 @@ def layer_apply(cfg: ModelConfig, params: Dict, h: jax.Array,
     activation (``nn.TransformerDecoderLayer``'s dropout/dropout1/2/3 for the
     ref arch; GPT-2's attn/resid dropout). Each site folds a distinct stream
     from ``rng``, so one per-layer key determines every mask."""
-    fl = cfg.use_flash_attention
+    fl = cfg.flash_for(cfg.causal, h.shape[1])
     heads = cfg.n_heads // tp_size
     p = cfg.dropout
 

@@ -1,24 +1,31 @@
-"""Fused flash-attention kernel in Pallas (Mosaic) for TPU.
+"""Fused flash-attention (forward + backward) in Pallas (Mosaic) for TPU.
 
 This is the framework's native-kernel layer — the TPU analog of the C++/ATen
 kernels the reference leans on through torch (SURVEY.md §2.3: "if a custom
-native kernel layer is wanted ... it is Pallas (Mosaic) kernels"). The kernel
-computes softmax(QK^T/sqrt(d))V one query block at a time with the online
-softmax recurrence (Dao et al., arXiv:2205.14135), so the [s, s] score matrix
-never hits HBM: per grid step it lives in VMEM as a [block_q, block_k] tile
-feeding the MXU.
+native kernel layer is wanted ... it is Pallas (Mosaic) kernels"). The
+forward computes softmax(QK^T/sqrt(d))V one query block at a time with the
+online softmax recurrence (Dao et al., arXiv:2205.14135), so the [s, s]
+score matrix never hits HBM: per grid step it lives in VMEM as a
+[block_q, block_k] tile feeding the MXU. The forward also emits the per-row
+logsumexp (lse), which is what makes the backward flash too.
 
-Layout: the grid is (batch*heads, seq/block_q); each kernel instance holds
-its query block plus the full K/V for that (batch, head) in VMEM and loops
-over K/V blocks with ``jax.lax.fori_loop`` + ``pl.ds`` dynamic slices.
-Causal masking prunes the loop to blocks at or below the diagonal.
+Backward (the real flash backward, not dense recompute): with o and lse
+saved, ``delta = rowsum(do * o)`` and the probabilities rebuild blockwise as
+``p = exp(s - lse)`` — no second online-softmax pass and no [s, s]
+materialization anywhere:
 
-Training support: ``flash_attention`` carries a ``jax.custom_vjp`` whose
-backward recomputes attention blockwise in plain XLA (flash-style
-rematerialization of the forward, dense [s, s] scores per (b, h) tile in the
-bwd matmuls — exact, memory-bounded by the backward tile, not by the kernel).
-On non-TPU backends the kernel runs in interpreter mode so CPU CI exercises
-the same code path.
+- ``dq`` kernel: grid (batch*heads, q blocks); each instance loops over the
+  live k blocks accumulating ``dq += (p * (do v^T - delta)) k``.
+- ``dk/dv`` kernel: grid (batch*heads, k blocks); each instance loops over
+  the live q blocks accumulating ``dv += p^T do`` and
+  ``dk += (p * (do v^T - delta))^T q``.
+
+Causal masking prunes both loops to live blocks (at/below the diagonal for
+dq, at/right of it for dk/dv), and a sliding ``window`` tightens both
+bounds, so backward compute scales the same way forward does.
+
+On non-TPU backends the kernels run in interpreter mode so CPU CI exercises
+the same code paths.
 """
 
 from __future__ import annotations
@@ -39,7 +46,24 @@ def _use_interpret() -> bool:
     return plat not in ("tpu", "axon")
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+def _keep_mask(qi_base, ki_base, shape, causal: bool, true_len: int,
+               seq_len: int, window: Optional[int]):
+    """[block_q, block_k] liveness mask, or None if everything is live.
+    Single source for forward and both backward kernels: padded key columns
+    are dead, causal drops cols > rows, window drops cols <= rows - window."""
+    if not causal and true_len == seq_len:
+        return None
+    rows = qi_base + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    cols = ki_base + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    keep = cols < true_len
+    if causal:
+        keep &= rows >= cols
+        if window is not None:
+            keep &= rows - cols < window
+    return keep
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                       causal: bool, scale: float, seq_len: int,
                       true_len: int, window: Optional[int]):
     qi = pl.program_id(1)
@@ -65,14 +89,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [bq, bk]
-        if causal or true_len != seq_len:
-            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            keep = cols < true_len  # keys in the ragged padding are dead
-            if causal:
-                keep &= rows >= cols
-                if window is not None:
-                    keep &= rows - cols < window
+        keep = _keep_mask(qi * block_q, ki * block_k, s.shape, causal,
+                          true_len, seq_len, window)
+        if keep is not None:
             s = jnp.where(keep, s, NEG_INF)
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m, m_blk)
@@ -90,20 +109,31 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
     m, l, acc = jax.lax.fori_loop(kv_start, n_kv_live, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    # per-row logsumexp of the (scaled, masked) scores; a fully-masked row
+    # lands near NEG_INF, which the backward's explicit keep-mask handles.
+    # lse rides as [bh, 1, s_pad] (rank-3) because Mosaic requires the last
+    # two block dims to tile (8, 128) or equal the array dims
+    lse_ref[0, 0] = m + jnp.log(l)
+
+
+def _pad_to_blocks(s: int, block_q: int, block_k: int) -> int:
+    blk = math.lcm(block_q, block_k)
+    return -(-s // blk) * blk
 
 
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
                block_q: int, block_k: int,
-               window: Optional[int] = None) -> jax.Array:
-    """q, k, v: [bh, s, dh] -> [bh, s, dh]. Ragged s (not a block multiple)
-    is zero-padded up front; padded key columns are masked dead in-kernel
-    and padded query rows are sliced off the output."""
+               window: Optional[int] = None):
+    """q, k, v: [bh, s, dh] -> (out [bh, s, dh], lse [bh, 1, s_pad]). Ragged s
+    (not a block multiple) is zero-padded up front; padded key columns are
+    masked dead in-kernel and padded query rows are sliced off the output
+    (the lse stays padded — it only feeds the backward kernels, which slice
+    consistently)."""
     bh, s, dh = q.shape
     scale = 1.0 / (dh ** 0.5)
     block_q = min(block_q, s)
     block_k = min(block_k, s)
-    blk = math.lcm(block_q, block_k)
-    s_pad = -(-s // blk) * blk
+    s_pad = _pad_to_blocks(s, block_q, block_k)
     if s_pad != s:
         pad = ((0, 0), (0, s_pad - s), (0, 0))
         q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
@@ -111,23 +141,171 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
                                causal=causal, scale=scale, seq_len=s_pad,
                                true_len=s, window=window)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((bh, 1, s_pad), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),
+        out_specs=(pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),
+                   pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j))),
         interpret=_use_interpret(),
     )(q, k, v)
-    return out[:, :s, :]
+    return out[:, :s, :], lse
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool, scale: float,
+                         seq_len: int, true_len: int,
+                         window: Optional[int]):
+    qi = pl.program_id(1)
+    qs = q_ref[0].astype(jnp.float32) * scale  # [block_q, dh]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]       # [block_q] f32
+    delta = delta_ref[0, 0]   # [block_q] f32
+    block_q = qs.shape[0]
+    dh = qs.shape[1]
+
+    n_kv = pl.cdiv(seq_len, block_k)
+    if causal:
+        n_kv_live = jax.lax.min(n_kv, ((qi + 1) * block_q + block_k - 1) // block_k)
+    else:
+        n_kv_live = n_kv
+    if window is not None:
+        kv_start = jax.lax.max(0, (qi * block_q - (window - 1)) // block_k)
+    else:
+        kv_start = 0
+
+    def body(ki, dq_acc):
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())))  # [bq, bk]
+        p = jnp.exp(s - lse[:, None])
+        keep = _keep_mask(qi * block_q, ki * block_k, s.shape, causal,
+                          true_len, seq_len, window)
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [bq, bk]
+        ds = p * (dp - delta[:, None])
+        return dq_acc + jax.lax.dot(ds, k)
+
+    dq0 = jnp.zeros((block_q, dh), jnp.float32)
+    dq = jax.lax.fori_loop(kv_start, n_kv_live, body, dq0)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          scale: float, seq_len: int, true_len: int,
+                          window: Optional[int]):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # [block_k, dh]
+    v = v_ref[0].astype(jnp.float32)
+    block_k = k.shape[0]
+    dh = k.shape[1]
+
+    n_q = pl.cdiv(seq_len, block_q)
+    if causal:
+        # first q block whose last row can see this k block's first key
+        q_start = (ki * block_k) // block_q
+    else:
+        q_start = 0
+    if window is not None:
+        # last q row that still sees this block's newest key is
+        # ki*block_k + block_k - 1 + window - 1
+        q_stop = jax.lax.min(
+            n_q, (ki * block_k + block_k - 1 + window - 1) // block_q + 1)
+    else:
+        q_stop = n_q
+
+    def body(qi, carry):
+        dk_acc, dv_acc = carry
+        qs = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())))  # [bq, bk]
+        p = jnp.exp(s - lse[:, None])
+        keep = _keep_mask(qi * block_q, ki * block_k, s.shape, causal,
+                          true_len, seq_len, window)
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
+        dv_new = dv_acc + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [bq, bk]
+        ds = p * (dp - delta[:, None])
+        # qs already carries the scale, so dk = ds^T (q * scale) needs none
+        dk_new = dk_acc + jax.lax.dot_general(ds, qs, (((0,), (0,)), ((), ())))
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((block_k, dh), jnp.float32)
+    dv0 = jnp.zeros((block_k, dh), jnp.float32)
+    dk, dv = jax.lax.fori_loop(q_start, q_stop, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, window):
+    """Blockwise dq/dk/dv from saved (o, lse): the [s, s] matrix never
+    materializes. Inputs [bh, s, dh] unpadded; lse [bh, 1, s_pad] (padded, from
+    the forward)."""
+    bh, s, dh = q.shape
+    scale = 1.0 / (dh ** 0.5)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    s_pad = _pad_to_blocks(s, block_q, block_k)
+    # delta_i = rowsum(do_i * o_i) in f32 — O(s*dh), the only non-kernel work
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, None, :]  # [bh, 1, s] (rank-3, see lse note)
+    if s_pad != s:
+        pad3 = ((0, 0), (0, s_pad - s), (0, 0))
+        q, k, v, g = (jnp.pad(x, pad3) for x in (q, k, v, g))
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, s_pad - s)))
+    common = dict(causal=causal, scale=scale, seq_len=s_pad, true_len=s,
+                  window=window)
+    qkv_spec_blocked_q = [
+        pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),   # q
+        pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),     # k
+        pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),     # v
+        pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),   # do
+        pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),    # lse
+        pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),    # delta
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, **common),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(bh, s_pad // block_q),
+        in_specs=qkv_spec_blocked_q,
+        out_specs=pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),
+        interpret=_use_interpret(),
+    )(q, k, v, g, lse, delta)
+
+    qkv_spec_blocked_k = [
+        pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),     # q
+        pl.BlockSpec((1, block_k, dh), lambda i, j: (i, j, 0)),   # k
+        pl.BlockSpec((1, block_k, dh), lambda i, j: (i, j, 0)),   # v
+        pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),     # do
+        pl.BlockSpec((1, 1, s_pad), lambda i, j: (i, 0, 0)),      # lse
+        pl.BlockSpec((1, 1, s_pad), lambda i, j: (i, 0, 0)),      # delta
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, **common),
+        out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
+        grid=(bh, s_pad // block_k),
+        in_specs=qkv_spec_blocked_k,
+        out_specs=(pl.BlockSpec((1, block_k, dh), lambda i, j: (i, j, 0)),
+                   pl.BlockSpec((1, block_k, dh), lambda i, j: (i, j, 0))),
+        interpret=_use_interpret(),
+    )(q, k, v, g, lse, delta)
+    return dq[:, :s, :], dk[:, :s, :], dv[:, :s, :]
 
 
 def _dense_attention(q, k, v, causal, window=None):
-    """Reference/backward path in plain XLA (f32 accumulation)."""
+    """Reference path in plain XLA (f32 accumulation) for tests/benchmarks."""
     dh = q.shape[-1]
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) / (dh ** 0.5)
@@ -141,18 +319,18 @@ def _dense_attention(q, k, v, causal, window=None):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, window):
-    return _flash_fwd(q, k, v, causal, block_q, block_k, window)
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, window)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, window):
-    return _flash_fwd(q, k, v, causal, block_q, block_k, window), (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, window)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, window, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _dense_attention(q, k, v, causal, window), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, window)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -165,10 +343,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """Fused attention: q, k, v [batch, seq, heads, head_dim] -> same shape.
 
     Drop-in replacement for the dense attention inside
-    ``ops.attention.mha_apply`` (GQA repeat must happen before the call).
-    ``window`` (requires ``causal``) applies the Mistral sliding-window
-    band: the kernel skips K/V blocks entirely outside
-    ``[i - window + 1, i]``, so long-sequence forward *compute* scales with
+    ``ops.attention.mha_apply`` (GQA repeat must happen before the call);
+    differentiable with a fully-blockwise Pallas backward (see module
+    docstring). ``window`` (requires ``causal``) applies the Mistral
+    sliding-window band: both directions skip K/V (resp. Q) blocks entirely
+    outside ``[i - window + 1, i]``, so long-sequence *compute* scales with
     the window. K/V VMEM residency still scales with the sequence (the
     whole [s, dh] K/V maps in per (batch, head)); truly long sequences
     should shard over a 'seq' mesh axis instead (ring attention).

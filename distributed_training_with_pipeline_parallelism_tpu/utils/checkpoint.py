@@ -102,9 +102,22 @@ def restore_checkpoint(path: str, template: Optional[Pytree] = None) -> Pytree:
             # CLI reading a checkpoint written under a simulated multi-device
             # mesh): pin those leaves to one local device and retry. Retry
             # ONLY for that condition — any other ValueError (shape/template
-            # mismatch) would just fail again after a multi-GB re-read.
-            if not had_none or "sharding" not in str(e):
+            # mismatch) would just fail again after a multi-GB re-read. The
+            # match is pinned to orbax's topology-resolution messages
+            # (jax_array_handlers.py: 'Unable to deserialize sharding.',
+            # 'Sharding of jax.Array cannot be None.') rather than the bare
+            # substring 'sharding', which also appears in genuine
+            # template-mismatch errors.
+            topology_failure = ("deserialize sharding" in str(e)
+                                or "Sharding of jax.Array cannot be None"
+                                in str(e))
+            if not had_none or not topology_failure:
                 raise
+            import logging
+            logging.getLogger(__name__).warning(
+                "checkpoint restore: saved device topology not resolvable "
+                "(%s); retrying with all unpinned leaves on a single local "
+                "device", e)
             dev0 = jax.sharding.SingleDeviceSharding(jax.devices()[0])
             pinned = jax.tree.map(
                 lambda s: s if s is ocp.PLACEHOLDER

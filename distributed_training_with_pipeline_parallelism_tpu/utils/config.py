@@ -10,7 +10,7 @@ driver stays declarative.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional, Tuple, Union
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,7 +63,13 @@ class ModelConfig:
     # right-padded batches of ragged sequences. None = every position counts
     # (the reference's regime).
     pad_token_id: Optional[int] = None
-    use_flash_attention: bool = False  # route attention through the Pallas kernel
+    # Attention kernel routing: True forces the Pallas flash kernel, False
+    # forces dense XLA softmax-matmuls, "auto" (default) picks flash exactly
+    # where it measures faster end-to-end on TPU — causal attention at
+    # seq >= 1024 with no attention-prob dropout (docs/performance.md: the
+    # flash backward is 1.15-24x the XLA dense backward there) — and dense
+    # everywhere else (short sequences, non-causal ref_decoder, CPU CI).
+    use_flash_attention: Union[bool, str] = "auto"
     use_fused_xent: bool = False  # route the loss through the Pallas fused-CE kernel
     remat_layers: bool = False  # jax.checkpoint each layer: trade FLOPs for HBM
     # Llama-only knobs.
@@ -121,16 +127,36 @@ class ModelConfig:
                                  f"be >= 1")
         if not 0.0 <= self.dropout < 1.0:
             raise ValueError(f"dropout={self.dropout} must be in [0, 1)")
-        if self.dropout > 0.0 and self.use_flash_attention:
+        if self.use_flash_attention not in (True, False, "auto"):
+            raise ValueError(
+                f"use_flash_attention={self.use_flash_attention!r} must be "
+                f"True, False, or 'auto'")
+        if self.dropout > 0.0 and self.use_flash_attention is True:
             raise ValueError(
                 "dropout composes with the dense XLA attention path only: "
                 "the Pallas flash kernel does not implement attention-prob "
                 "dropout (torch applies dropout to attention weights, so "
-                "silently skipping it would change train-mode semantics)")
+                "silently skipping it would change train-mode semantics; "
+                "'auto' resolves to the dense path under dropout)")
 
     @property
     def causal(self) -> bool:
         return self.arch != "ref_decoder"
+
+    def flash_for(self, causal: bool, seq_len: int) -> bool:
+        """Resolve ``use_flash_attention`` for one attention call site.
+        'auto' = flash exactly where it measured faster end-to-end on real
+        TPU (docs/performance.md): causal, seq >= 1024, no attention-prob
+        dropout. Non-TPU backends resolve to dense — the kernel only runs
+        in (slow) interpreter mode there."""
+        if self.use_flash_attention is True:
+            return True
+        if self.use_flash_attention == "auto":
+            if self.dropout > 0.0 or not causal or seq_len < 1024:
+                return False
+            import jax
+            return jax.devices()[0].platform in ("tpu", "axon")
+        return False
 
     @property
     def storage_dtype(self) -> str:
@@ -204,7 +230,11 @@ def virtual_stages_for(schedule_name: str, n_layers: int, n_pipe: int) -> int:
     _check_schedule_name(schedule_name)
     if schedule_name == "ZBV":
         return 2
-    if schedule_name == "Interleaved1F1B" and n_layers % (n_pipe * 2) == 0:
+    # BFS gets the same 2-chunk rule as Interleaved: with V=1 it degenerates
+    # to GPipe by construction (every breadth-first round is the whole
+    # device ring), so sweep rows labeled BFS would silently benchmark GPipe.
+    if (schedule_name in ("Interleaved1F1B", "BFS")
+            and n_layers % (n_pipe * 2) == 0):
         return 2
     return 1
 

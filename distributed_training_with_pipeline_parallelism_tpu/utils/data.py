@@ -142,6 +142,11 @@ def encode_text_file_hf(text_path: str, out_path: str,
     uint16 packs vocabs < 65536 (GPT-2's 50257 fits); larger tokenizers fall
     back to uint32 automatically (``TokenFileDataset(dtype=np.uint32)`` to
     read those).
+
+    Chunks cut at whitespace so the stream matches one-shot encoding;
+    whitespace-free runs accumulate (up to 64x ``chunk_chars``) until a cut
+    point appears. Only a single whitespace-free run longer than that bound
+    is ever cut mid-run, where one token may split versus one-shot encoding.
     """
     if isinstance(tokenizer, str):
         from transformers import AutoTokenizer
@@ -185,8 +190,18 @@ def encode_text_file_hf(text_path: str, out_path: str,
             # (GPT-2-style BPE attaches the leading space to the word)
             cut = max(chunk.rfind(" "), chunk.rfind("\n"))
             if cut <= 0:
-                carry = ""
-                emit(chunk, out)
+                # no whitespace anywhere (minified/CJK text): any cut here
+                # would split a token and diverge from one-shot encoding, so
+                # keep accumulating until whitespace appears. Bound the
+                # accumulation (64x chunk_chars) so a pathological fully
+                # whitespace-free file cannot OOM the host — past the bound
+                # the chunk is emitted whole and the stream may split one
+                # token at that boundary (documented divergence).
+                if len(chunk) < 64 * chunk_chars:
+                    carry = chunk
+                else:
+                    carry = ""
+                    emit(chunk, out)
             else:
                 carry = chunk[cut:]
                 emit(chunk[:cut], out)

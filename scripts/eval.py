@@ -35,6 +35,11 @@ def main():
     ap.add_argument("--heads", type=int, default=0)
     ap.add_argument("--vocab", type=int, default=0)
     ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--param-dtype", default=None,
+                    help="storage dtype of the checkpoint's params (mirror "
+                         "scripts/train.py --param-dtype for mixed-precision "
+                         "checkpoints, e.g. --dtype bfloat16 "
+                         "--param-dtype float32)")
     ap.add_argument("--simulate-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -72,6 +77,8 @@ def main():
         dim=args.dim, ffn_dim=args.ffn, n_layers=args.layers,
         n_heads=args.heads, vocab_size=args.vocab).items() if v}
     overrides["dtype"] = args.dtype
+    if args.param_dtype:
+        overrides["param_dtype"] = args.param_dtype
     if args.dim and not args.ffn:
         base = build_cfg()
         overrides["ffn_dim"] = max(1, round(base.ffn_dim * args.dim / base.dim))

@@ -65,8 +65,12 @@ def main():
                          "TransformerDecoderLayer default is 0.1); masks are "
                          "seeded from --seed and independent of the mesh")
     ap.add_argument("--dtype", default="float32")
-    ap.add_argument("--flash", action="store_true",
-                    help="Pallas fused flash attention")
+    ap.add_argument("--flash", default=None, nargs="?", const="on",
+                    choices=["on", "off", "auto"],
+                    help="Pallas fused flash attention (bare --flash means "
+                         "on; default auto: on for causal seq>=1024 on TPU, "
+                         "where it measures faster; see "
+                         "docs/performance.md)")
     ap.add_argument("--fused-xent", action="store_true",
                     help="Pallas fused cross-entropy loss")
     ap.add_argument("--ckpt", default="",
@@ -183,8 +187,9 @@ def main():
         overrides["param_dtype"] = args.param_dtype
     if args.dropout:
         overrides["dropout"] = args.dropout
-    if args.flash:
-        overrides["use_flash_attention"] = True
+    if args.flash is not None:
+        overrides["use_flash_attention"] = {
+            "on": True, "off": False, "auto": "auto"}[args.flash]
     if args.fused_xent:
         overrides["use_fused_xent"] = True
     if args.dim and not args.ffn:

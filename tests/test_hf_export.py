@@ -120,6 +120,41 @@ def test_encode_text_file_hf(tmp_path):
     assert out.read_bytes() == out2.read_bytes()
 
 
+def test_encode_whitespace_free_chunks_match_oneshot(tmp_path):
+    """A whitespace-free run longer than chunk_chars (minified/CJK-style
+    text) must still encode identically to one-shot: chunks accumulate until
+    a cut point instead of splitting a token at the boundary (ADVICE r1 #5)."""
+    from distributed_training_with_pipeline_parallelism_tpu.utils.data import (
+        encode_text_file_hf)
+
+    class PairTok:
+        """Per-word 2-char-pair tokenizer: whitespace is a safe cut point
+        (like BPE pre-tokenization) but splitting inside a word realigns the
+        pairs and changes the ids — exactly the straddling-token failure."""
+        def __len__(self):
+            return 1 << 8
+
+        def __call__(self, text, add_special_tokens=True):
+            ids = []
+            for word in text.split():
+                for i in range(0, len(word), 2):
+                    pair = word[i:i + 2]
+                    ids.append((ord(pair[0]) * 7
+                                + (ord(pair[1]) if len(pair) > 1 else 31))
+                               % 251)
+            return {"input_ids": ids}
+
+    src = tmp_path / "minified.txt"
+    # 100-char whitespace-free run >> chunk_chars=16, then normal text
+    src.write_text("x" + "ab" * 50 + " tail words here")
+    one = tmp_path / "one.bin"
+    chunked = tmp_path / "chunked.bin"
+    encode_text_file_hf(str(src), str(one), tokenizer=PairTok())
+    encode_text_file_hf(str(src), str(chunked), tokenizer=PairTok(),
+                        chunk_chars=16)
+    assert one.read_bytes() == chunked.read_bytes()
+
+
 def test_encode_large_vocab_uint32_sidecar(tmp_path):
     """A >=2^16-vocab tokenizer writes uint32 + a sidecar, and
     TokenFileDataset reads it back correctly with no dtype flag."""

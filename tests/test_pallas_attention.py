@@ -105,6 +105,46 @@ def test_flash_sliding_window_matches_dense(window, block):
                                    atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_ragged_and_noncausal(causal):
+    """Backward with padded rows/cols (s not a block multiple) and in the
+    non-causal path: the Pallas dq/dkv kernels must mask padded keys dead
+    and keep padded-query contributions zero."""
+    b, s, h, dh = 2, 29, 2, 8
+    ks = jax.random.split(jax.random.key(5), 4)
+    q = jax.random.normal(ks[0], (b, s, h, dh))
+    k = jax.random.normal(ks[1], (b, s, h, dh))
+    v = jax.random.normal(ks[2], (b, s, h, dh))
+    g = jax.random.normal(ks[3], q.shape)
+
+    gf = jax.grad(lambda q, k, v: jnp.vdot(
+        flash_attention(q, k, v, causal=causal, block_q=8, block_k=8), g),
+        argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: jnp.vdot(_full(q, k, v, causal), g),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_flash_auto_resolution():
+    """'auto' picks flash only where it measured faster: causal, seq>=1024,
+    no dropout, TPU backend (CPU CI resolves dense)."""
+    cfg = dtpp.ModelConfig(arch="gpt2")
+    assert cfg.use_flash_attention == "auto"
+    # CPU backend (the test env): always dense
+    assert cfg.flash_for(True, 2048) is False
+    # explicit True/False override auto everywhere
+    assert dtpp.ModelConfig(use_flash_attention=True).flash_for(False, 8) is True
+    assert dtpp.ModelConfig(use_flash_attention=False).flash_for(True, 4096) is False
+    # dropout composes with dense only; auto resolves off, True raises
+    assert dtpp.ModelConfig(arch="gpt2", dropout=0.1).flash_for(True, 4096) is False
+    with pytest.raises(ValueError, match="dense"):
+        dtpp.ModelConfig(arch="gpt2", dropout=0.1, use_flash_attention=True)
+    with pytest.raises(ValueError, match="use_flash_attention"):
+        dtpp.ModelConfig(use_flash_attention="maybe")
+
+
 def test_flash_window_requires_causal():
     q = jnp.zeros((1, 8, 1, 4))
     with pytest.raises(ValueError, match="causal"):

@@ -40,6 +40,16 @@ def test_interleaved_virtual_stage_rule(mini_sweep_df):
     assert il.loc[4, "n_virtual"] == 1
 
 
+def test_bfs_virtual_stage_rule():
+    # BFS with V=1 degenerates to GPipe by construction, so the sweep rule
+    # gives it the same 2-chunk treatment as Interleaved (ADVICE r1 #1)
+    from distributed_training_with_pipeline_parallelism_tpu.utils.config import (
+        virtual_stages_for)
+    assert virtual_stages_for("BFS", 4, 2) == 2
+    assert virtual_stages_for("BFS", 4, 4) == 1  # 4 % 8 != 0
+    assert virtual_stages_for("GPipe", 4, 2) == 1
+
+
 def test_speedup_and_efficiency(mini_sweep_df):
     sp = compute_speedup_and_efficiency(mini_sweep_df)
     assert len(sp) == 4  # 2 schedules x 2 device counts
@@ -70,3 +80,20 @@ def test_plots(mini_sweep_df, tmp_path):
     plotting.plot_speedup_and_efficiency(sp, str(p1))
     plotting.plot_throughput_grid(mini_sweep_df, str(p2))
     assert p1.stat().st_size > 0 and p2.stat().st_size > 0
+
+
+def test_schedule_timeline_plots(tmp_path):
+    """Timeline diagrams render from compiled tick tables for every builtin
+    schedule family (reference Part 1 cells 4/7/9/11, made exact)."""
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules import (
+        compile_schedule)
+    for name, D, V, M in [("GPipe", 4, 1, 4), ("1F1B", 4, 1, 4),
+                          ("Interleaved1F1B", 4, 2, 8), ("ZBH1", 4, 1, 8),
+                          ("ZBV", 4, 2, 8), ("BFS", 4, 2, 8)]:
+        p = tmp_path / f"{name}.png"
+        plotting.plot_schedule_timeline(name, D, V, M, path=str(p))
+        assert p.stat().st_size > 0
+    # the CompiledSchedule overload renders identically
+    cs = compile_schedule("1F1B", 2, 1, 4)
+    fig = plotting.plot_schedule_timeline(cs)
+    assert fig is not None
