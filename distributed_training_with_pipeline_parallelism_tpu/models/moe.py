@@ -123,15 +123,27 @@ def moe_ffn_init(key: jax.Array, dim: int, ffn_dim: int, n_experts: int) -> Dict
     }
 
 
-def _expert_mlp(params: Dict, x: jax.Array) -> jax.Array:
-    """Per-expert gelu MLP on [E_local, N, d] slot blocks (batched einsums)."""
+def _expert_mlp(params: Dict, x: jax.Array,
+                tp_axis: Optional[str] = None) -> jax.Array:
+    """Per-expert gelu MLP on [E_local, N, d] slot blocks (batched einsums).
+
+    With ``tp_axis`` the expert matrices are Megatron-split over that mesh
+    axis — w1/b1 column-parallel on the ffn dim, w2 row-parallel with one
+    psum completing the partial outputs and b2 (replicated) added once —
+    exactly the dense ``lin1``/``lin2`` pattern, batched over experts."""
+    if tp_axis is not None:
+        from ..ops.collectives import tp_copy, tp_reduce
+        x = tp_copy(x, tp_axis)
     h = jnp.einsum("end,edf->enf", x, params["w1"]) + params["b1"][:, None]
-    return jnp.einsum("enf,efd->end", jax.nn.gelu(h), params["w2"]
-                      ) + params["b2"][:, None]
+    out = jnp.einsum("enf,efd->end", jax.nn.gelu(h), params["w2"])
+    if tp_axis is not None:
+        out = tp_reduce(out, tp_axis)
+    return out + params["b2"][:, None]
 
 
 def moe_ffn_apply(params: Dict, x: jax.Array, moe: MoEConfig,
                   axis_name: Optional[str] = None,
+                  tp_axis: Optional[str] = None,
                   ) -> Tuple[jax.Array, jax.Array]:
     """MoE FFN on [B, S, d] activations -> ([B, S, d], aux loss scalar).
 
@@ -160,7 +172,7 @@ def moe_ffn_apply(params: Dict, x: jax.Array, moe: MoEConfig,
             raise ValueError(
                 f"params hold {params['w1'].shape[0]} experts, config says {E} "
                 f"(running an expert-sharded pytree without axis_name?)")
-        out = _expert_mlp(params, slots)  # [E, C, d]
+        out = _expert_mlp(params, slots, tp_axis)  # [E, C, d]
     else:
         D = jax.lax.psum(1, axis_name)
         G = params["w1"].shape[0]  # local experts
@@ -169,7 +181,7 @@ def moe_ffn_apply(params: Dict, x: jax.Array, moe: MoEConfig,
         send = slots.reshape(D, G, C, d)
         recv = jax.lax.all_to_all(send, axis_name, 0, 0)  # [D_src, G, C, d]
         hid = recv.transpose(1, 0, 2, 3).reshape(G, D * C, d)
-        hid = _expert_mlp(params, hid)
+        hid = _expert_mlp(params, hid, tp_axis)
         back = hid.reshape(G, D, C, d).transpose(1, 0, 2, 3)
         out = jax.lax.all_to_all(back, axis_name, 0, 0).reshape(E, C, d)
     y = jnp.einsum("tec,ecd->td", combine, out)
@@ -194,11 +206,18 @@ def moe_layer_init(key: jax.Array, cfg: ModelConfig, moe: MoEConfig) -> Dict:
 
 def moe_layer_apply(cfg: ModelConfig, moe: MoEConfig, params: Dict,
                     h: jax.Array, axis_name: Optional[str] = None,
-                    ) -> Tuple[jax.Array, jax.Array]:
+                    tp_axis: Optional[str] = None,
+                    tp_size: int = 1) -> Tuple[jax.Array, jax.Array]:
+    """One MoE decoder block. ``axis_name`` shards experts (EP);
+    ``tp_axis``/``tp_size`` additionally Megatron-shards the attention
+    heads and each expert's ffn dim over the model axis — EP moves whole
+    experts across devices, TP splits every expert's matmuls, and the two
+    compose (each expert shard group runs its ffn slice)."""
     a = layer_norm_apply(params["ln1"], h)
-    h = h + mha_apply(params["attn"], a, a, cfg.n_heads, causal=True)
+    h = h + mha_apply(params["attn"], a, a, cfg.n_heads // tp_size,
+                      causal=True, tp_axis=tp_axis, tp_size=tp_size)
     m = layer_norm_apply(params["ln2"], h)
-    y, aux = moe_ffn_apply(params["moe"], m, moe, axis_name)
+    y, aux = moe_ffn_apply(params["moe"], m, moe, axis_name, tp_axis)
     return h + y, aux
 
 

@@ -31,7 +31,7 @@ from ..ops.attention import mha_apply, mha_init, rope_frequencies
 from ..ops.layers import (dropout_apply, embedding_apply, embedding_init,
                           layer_norm_apply, layer_norm_init, linear_apply,
                           linear_init, rms_norm_apply, rms_norm_init,
-                          select_xent)
+                          select_xent, sharded_dropout_apply)
 from ..utils.config import ModelConfig
 
 # ---------------------------------------------------------------------------
@@ -98,23 +98,28 @@ def layer_apply(cfg: ModelConfig, params: Dict, h: jax.Array,
     if cfg.arch == "ref_decoder":
         mem = h  # the reference calls layer(h, h): memory is the layer's input
         sa = mha_apply(params["self_attn"], h, h, heads, flash=fl,
-                       tp_axis=tp_axis, dropout_rate=p, dropout_rng=site(0))
+                       tp_axis=tp_axis, tp_size=tp_size, dropout_rate=p,
+                       dropout_rng=site(0))
         x = layer_norm_apply(params["ln1"], h + dropout_apply(sa, p, site(1)))
         ca = mha_apply(params["cross_attn"], x, mem, heads, flash=fl,
-                       tp_axis=tp_axis, dropout_rate=p, dropout_rng=site(2))
+                       tp_axis=tp_axis, tp_size=tp_size, dropout_rate=p,
+                       dropout_rng=site(2))
         x = layer_norm_apply(params["ln2"], x + dropout_apply(ca, p, site(3)))
+        # the FFN-inner activation is a column-parallel local shard under
+        # TP: its mask is the global mask's local slice (oracle-exact)
         ff = _ffn_out(params["lin2"],
-                      dropout_apply(
+                      sharded_dropout_apply(
                           jax.nn.relu(linear_apply(params["lin1"],
                                                    _tp_in(x, tp_axis))),
-                          p, site(4)),
+                          p, site(4), axis=tp_axis, n_shards=tp_size,
+                          shard_dim=-1),
                       tp_axis)
         return layer_norm_apply(params["ln3"], x + dropout_apply(ff, p, site(5)))
     if cfg.arch == "gpt2":
         a = layer_norm_apply(params["ln1"], h)
         attn = mha_apply(params["attn"], a, a, heads, causal=cfg.causal,
-                         flash=fl, tp_axis=tp_axis, dropout_rate=p,
-                         dropout_rng=site(0))
+                         flash=fl, tp_axis=tp_axis, tp_size=tp_size,
+                         dropout_rate=p, dropout_rng=site(0))
         h = h + dropout_apply(attn, p, site(1))
         return mlp_block(cfg, params, h, tp_axis=tp_axis, rng=site(2),
                          dropout=p)
@@ -122,8 +127,8 @@ def layer_apply(cfg: ModelConfig, params: Dict, h: jax.Array,
         a = rms_norm_apply(params["rms1"], h, cfg.rms_eps)
         attn = mha_apply(params["attn"], a, a, heads, causal=cfg.causal,
                          rope_angles=rope_angles, flash=fl, tp_axis=tp_axis,
-                         window=cfg.sliding_window, dropout_rate=p,
-                         dropout_rng=site(0))
+                         tp_size=tp_size, window=cfg.sliding_window,
+                         dropout_rate=p, dropout_rng=site(0))
         h = h + dropout_apply(attn, p, site(1))
         return mlp_block(cfg, params, h, tp_axis=tp_axis, rng=site(2),
                          dropout=p)

@@ -17,7 +17,8 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from .layers import dropout_apply, linear_init, linear_apply
+from .layers import (dropout_apply, linear_init, linear_apply,
+                     sharded_dropout_apply)
 
 
 def mha_init(key: jax.Array, dim: int, n_heads: int, n_kv_heads: Optional[int] = None,
@@ -104,7 +105,8 @@ def gqa_expand(k: jax.Array, v: jax.Array, n_heads: int):
 def scaled_dot_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                          mask: Optional[jax.Array] = None,
                          dropout_rate: float = 0.0,
-                         dropout_rng=None) -> jax.Array:
+                         dropout_rng=None,
+                         head_shard: Optional[tuple] = None) -> jax.Array:
     """Core attention: q [b,s,h,d] x k/v [b,t,h,d] -> [b,s,h,d].
 
     ``mask`` broadcasts against scores [b,h,s,t]; False positions are dropped.
@@ -112,14 +114,22 @@ def scaled_dot_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     path (:mod:`..models.generate`) so the two cannot drift. Softmax runs in
     f32 regardless of activation dtype. ``dropout_rng`` (train mode) applies
     dropout to the attention probabilities, as torch's MultiheadAttention
-    does with a nonzero ``dropout`` constructor arg.
+    does with a nonzero ``dropout`` constructor arg. ``head_shard`` —
+    ``(axis_name, n_shards)`` when the head dim is a tensor/sequence-parallel
+    local shard — keys the dropout mask to the *global* head index so the
+    sharded run reproduces the unsharded masks exactly.
     """
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=q.dtype))
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    probs = dropout_apply(probs, dropout_rate, dropout_rng)
+    if head_shard is not None and head_shard[1] > 1:
+        probs = sharded_dropout_apply(probs, dropout_rate, dropout_rng,
+                                      axis=head_shard[0],
+                                      n_shards=head_shard[1], shard_dim=1)
+    else:
+        probs = dropout_apply(probs, dropout_rate, dropout_rng)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -148,7 +158,7 @@ def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
               causal: bool = False, rope_angles: Optional[jax.Array] = None,
               flash: bool = False, tp_axis: Optional[str] = None,
               window: Optional[int] = None, dropout_rate: float = 0.0,
-              dropout_rng=None) -> jax.Array:
+              dropout_rng=None, tp_size: int = 1) -> jax.Array:
     """Attention: queries from ``q_in``, keys/values from ``kv_in`` (both [b, s, d]).
 
     ``flash=True`` routes the core attention through the fused Pallas kernel
@@ -174,6 +184,8 @@ def mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array, n_heads: int,
         if causal:
             s = q_in.shape[1]
             mask = band_mask(s, s, window)[None, None]
-        out = scaled_dot_attention(q, k, v, mask, dropout_rate, dropout_rng)
+        out = scaled_dot_attention(
+            q, k, v, mask, dropout_rate, dropout_rng,
+            head_shard=(tp_axis, tp_size) if tp_axis is not None else None)
     out = out.reshape(q_in.shape[0], q_in.shape[1], -1)
     return tp_output_projection(params["o"], out, tp_axis)

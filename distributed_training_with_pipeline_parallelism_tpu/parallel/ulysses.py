@@ -35,7 +35,9 @@ from ..ops.layers import linear_apply
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                      axis_name: str, causal: bool = False) -> jax.Array:
+                      axis_name: str, causal: bool = False,
+                      dropout_rate: float = 0.0,
+                      dropout_rng=None) -> jax.Array:
     """Exact attention with the sequence sharded over ``axis_name``.
 
     q, k, v: [batch, seq_local, heads, head_dim] per-device shards. Q heads
@@ -63,7 +65,12 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if causal:
         s = q.shape[1]
         mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None]
-    out = scaled_dot_attention(q, k, v, mask)
+    # post-scatter the probs are [b, h/D, s, s] — a head-block shard of the
+    # unsharded probs, so attention-prob dropout uses the same axis-aware
+    # full-draw+slice masks as tensor parallelism (oracle-exact)
+    out = scaled_dot_attention(q, k, v, mask, dropout_rate, dropout_rng,
+                               head_shard=(axis_name, D)
+                               if dropout_rng is not None else None)
     # [b, s, h/D, dh] -> [b, s/D, h, dh]
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
@@ -72,7 +79,9 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def ulysses_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
                       n_heads: int, axis_name: str, causal: bool = False,
                       rope_angles: Optional[jax.Array] = None,
-                      tp_axis: Optional[str] = None) -> jax.Array:
+                      tp_axis: Optional[str] = None,
+                      dropout_rate: float = 0.0,
+                      dropout_rng=None) -> jax.Array:
     """Sequence-parallel drop-in for ``ops.attention.mha_apply`` (same
     signature as :func:`..ring_attention.ring_mha_apply`): projections are
     position-wise (local); the attention core re-shards via all-to-all.
@@ -91,5 +100,7 @@ def ulysses_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
     b, s, _ = q_in.shape
     q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles,
                           expand_gqa=False)  # expansion happens post-gather
-    out = ulysses_attention(q, k, v, axis_name, causal=causal)
+    out = ulysses_attention(q, k, v, axis_name, causal=causal,
+                            dropout_rate=dropout_rate,
+                            dropout_rng=dropout_rng)
     return linear_apply(params["o"], out.reshape(b, s, -1))

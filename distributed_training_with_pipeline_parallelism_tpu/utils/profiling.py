@@ -19,6 +19,13 @@ collected). Here:
 Note the measured number also absorbs communication and remat overhead, so
 it upper-bounds the pure schedule bubble; the gap between measured and
 simulated (w_b=3) is the transport+overhead cost.
+
+Caveat for simulated (CPU) meshes: the measurement assumes the D mesh
+devices actually run in parallel. On a host with fewer cores than devices
+the "parallel" ticks serialize and ``bubble_measured`` degenerates toward
+``1 - 1/D`` regardless of schedule (docs/performance.md §bubbles) — use
+the tick simulation for schedule comparisons there, and reserve this
+function for real multi-chip slices.
 """
 
 from __future__ import annotations

@@ -139,6 +139,59 @@ def test_pipeline_dropout_partition_invariance(problem, name, D, V, M):
     assert max(jax.tree.leaves(err)) < 1e-5
 
 
+@pytest.mark.parametrize("arch,kw", [
+    ("ref_decoder", dict()),
+    ("gpt2", dict(max_seq_len=8)),
+])
+def test_pipeline_dropout_with_tensor_parallel(arch, kw):
+    """dropout x TP (VERDICT r1 item 5): the sharded sites (attention probs
+    over local heads, FFN-inner hidden slice) draw the full-shape mask and
+    slice, so a pp x tp run reproduces the unsharded masks exactly."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=50,
+                           ffn_dim=64, dropout=0.25, arch=arch, **kw)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 8), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (8, 8), 0, cfg.vocab_size)
+    rng = jax.random.key(5)
+    sched = dtpp.ScheduleConfig(name="GPipe", n_microbatches=2)
+    base = make_pipeline_step(cfg, make_mesh(n_pipe=2), sched)
+    loss0, grads0 = jax.device_get(base(params, tokens, targets, rng))
+    step = make_pipeline_step(cfg, make_mesh(n_pipe=2, n_model=2), sched)
+    loss, grads = jax.device_get(step(params, tokens, targets, rng))
+    assert abs(loss - loss0) < 1e-5
+    import numpy as np
+    err = jax.tree.map(lambda a, b: float(np.max(np.abs(a - b))),
+                       grads, grads0)
+    assert max(jax.tree.leaves(err)) < 1e-5
+
+
+def test_pipeline_dropout_with_sequence_parallel():
+    """dropout x SP via Ulysses (VERDICT r1 item 5): residual/FFN masks are
+    the full-sequence masks' local slices; attention-prob masks ride the
+    post-scatter head blocks. Ring attention rejects the combination."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=50,
+                           ffn_dim=64, dropout=0.25, arch="gpt2",
+                           max_seq_len=16)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (4, 16), 0, cfg.vocab_size)
+    rng = jax.random.key(9)
+    sched = dtpp.ScheduleConfig(name="GPipe", n_microbatches=2)
+    base = make_pipeline_step(cfg, make_mesh(n_pipe=2), sched)
+    loss0, grads0 = jax.device_get(base(params, tokens, targets, rng))
+    step = make_pipeline_step(cfg, make_mesh(n_pipe=2, n_seq=2), sched,
+                              sp_attn_impl="ulysses")
+    loss, grads = jax.device_get(step(params, tokens, targets, rng))
+    assert abs(loss - loss0) < 1e-5
+    import numpy as np
+    err = jax.tree.map(lambda a, b: float(np.max(np.abs(a - b))),
+                       grads, grads0)
+    assert max(jax.tree.leaves(err)) < 1e-5
+    with pytest.raises(NotImplementedError, match="ring"):
+        make_pipeline_step(cfg, make_mesh(n_pipe=2, n_seq=2), sched,
+                           sp_attn_impl="ring")
+
+
 def test_train_step_with_dropout_smoke():
     from distributed_training_with_pipeline_parallelism_tpu.utils.train import (
         fit, synthetic_data)

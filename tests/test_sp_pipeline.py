@@ -50,6 +50,20 @@ def test_pp_sp_matches_single_device(arch, kw):
     _check(step, *prob)
 
 
+def test_pp_sp_gemma_knobs():
+    """Gemma-family knobs through seq-parallel stages (VERDICT r1 item 4
+    guard lift): embed_scale, GeGLU MLP, decoupled head_dim, tied head."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=32, arch="llama",
+                           mlp_act="gelu", embed_scale=True,
+                           head_dim_override=16, tie_embeddings=True)
+    prob = _problem(cfg)
+    mesh = make_mesh(n_pipe=2, n_seq=4)
+    step = make_pipeline_step(
+        cfg, mesh, dtpp.ScheduleConfig(name="GPipe", n_microbatches=2))
+    _check(step, *prob)
+
+
 def test_dp_pp_sp_1f1b():
     cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
                            ffn_dim=64, max_seq_len=32, arch="gpt2")

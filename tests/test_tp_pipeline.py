@@ -129,6 +129,42 @@ def test_vocab_parallel_head_with_dp():
     _check(step, *prob)
 
 
+@pytest.mark.parametrize("arch", ["gpt2", "llama"])
+def test_vocab_parallel_head_tied_embeddings(arch):
+    """tied x vocab-parallel CE (VERDICT r1 item 5): each model shard uses
+    its vocab-row slice of the embedding as local head columns; the
+    backward psums the per-shard partial row-grads into the full table
+    grad, on top of the replicated lookup grad."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=16, arch=arch,
+                           tie_embeddings=True)
+    prob = _problem(cfg)
+    mesh = make_mesh(n_pipe=2, n_model=2)
+    step = make_pipeline_step(
+        cfg, mesh, dtpp.ScheduleConfig(name="1F1B", n_microbatches=4),
+        tp_vocab_parallel=True)
+    _check(step, *prob)
+
+
+def test_vocab_parallel_tied_with_pad_masking():
+    """tied x vocab-parallel x ignore-index: the masked-sum path flows
+    through the same sliced-table logits."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, arch="gpt2", tie_embeddings=True,
+                           pad_token_id=0)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 6), 0, 64)
+    targets = jax.random.randint(jax.random.key(2), (8, 6), 0, 64)
+    targets = targets.at[:, -2:].set(0)  # right-pad tail
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(cfg, p, tokens, targets))(params)
+    mesh = make_mesh(n_pipe=2, n_model=2)
+    step = make_pipeline_step(
+        cfg, mesh, dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
+        tp_vocab_parallel=True)
+    _check(step, params, tokens, targets, ref_loss, ref_grads)
+
+
 def test_vocab_parallel_head_validation():
     cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=63,
                            ffn_dim=64, arch="gpt2")
