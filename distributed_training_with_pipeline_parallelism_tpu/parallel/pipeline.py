@@ -72,6 +72,22 @@ def _shard_map(fn, mesh, in_specs, out_specs):
 Pytree = Any
 
 
+def _fsdp_sharded_mask(cfg: ModelConfig, n_data: int) -> Pytree:
+    """Which layer leaves shard over 'data' under pp x fsdp: MATRICES whose
+    first weight dim divides n_data (q/k/v/o/ffn weights — template leaves
+    are layer-stacked ``[L, w0, ...]``, so a matrix has ndim >= 3). Norm
+    scales and biases ([L, dim], ndim 2) stay replicated: they are O(dim),
+    noise next to the matrices, and sharding them would add latency-bound
+    collectives per tick for nothing. The SINGLE source of the layout —
+    ``make_pipeline_grad_fn``'s in/out specs and ``fsdp_shard_params``'s
+    placement must agree or jit silently reshards every leaf every step."""
+    from ..models.transformer import transformer_init
+    template = jax.eval_shape(
+        lambda: transformer_init(jax.random.key(0), cfg))["layers"]
+    return jax.tree.map(
+        lambda l: l.ndim >= 3 and l.shape[1] % n_data == 0, template)
+
+
 def _compile(name: str, D: int, V: int, M: int) -> CompiledSchedule:
     """Compile via the native C++ engine when available (bit-identical to the
     Python compiler — see tests/test_native_engine.py), else in Python.
@@ -154,6 +170,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                           force_tick_executor: bool = False, moe=None,
                           sp_attn_impl: str = "ring",
                           tp_vocab_parallel: bool = False,
+                          fsdp: bool = False,
                           ) -> Callable[[Pytree, jax.Array, jax.Array],
                                         Tuple[jax.Array, Pytree]]:
     """Build an (unjitted) ``(params, tokens, targets) -> (loss, grads)``
@@ -172,6 +189,16 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     ``B`` divisible by (n_data * n_microbatches); the batch is split over the
     'data' mesh axis, then into microbatches along dim 0 (upstream
     ``DEFAULT_CHUNK_DIM=0``, ``microbatch.py:57``).
+
+    ``fsdp=True`` (pp x fsdp, ZeRO-3 within the pipeline): per-stage layer
+    weights live sharded over the 'data' axis (first weight dim split
+    n_data ways — use :func:`fsdp_shard_params` to place them), each tick's
+    active virtual chunk is all-gathered just in time inside the compute
+    unit, and layer gradients are reduce-scattered per backward tick, so
+    the grad accumulator carry is sharded too. Per-device layer-param
+    residency drops from full-stage to 1/n_data of it (+ one transient
+    gathered chunk); grads/optimizer state inherit the sharding through
+    the returned pytree. Dense stages only (no model/seq/expert axes).
     """
     D = mesh.shape[PIPE_AXIS]
     n_data = mesh.shape.get(DATA_AXIS, 1)
@@ -206,6 +233,15 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     ep_axis = EXPERT_AXIS if n_ep > 1 else None
     if n_ep > 1 and moe is None:
         raise ValueError("mesh has an 'expert' axis but no MoEConfig given")
+    if fsdp:
+        if n_data <= 1:
+            raise ValueError("fsdp=True needs a 'data' mesh axis to shard "
+                             "parameters over")
+        if T > 1 or n_seq > 1 or moe is not None:
+            raise NotImplementedError(
+                "pp x fsdp composes with dense data x pipe meshes; model/"
+                "seq/expert axes would need a second sharding dim per leaf")
+    fsdp_sharded = _fsdp_sharded_mask(cfg, n_data) if fsdp else None
     use_dropout = cfg.dropout > 0.0
     if use_dropout and moe is not None:
         raise NotImplementedError(
@@ -361,6 +397,31 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 lambda x: jax.lax.dynamic_index_in_dim(x, v, 0, keepdims=False),
                 tree)
 
+        def stage_params(vv):
+            """This tick's active chunk parameters. Under fsdp the sharded
+            leaves all-gather over 'data' just in time — only ONE chunk's
+            full weights are ever resident, and only for the tick."""
+            p = select_v(layers_local, vv)
+            if not fsdp:
+                return p
+            return jax.tree.map(
+                lambda x, sh: jax.lax.all_gather(x, DATA_AXIS, axis=1,
+                                                 tiled=True) if sh else x,
+                p, fsdp_sharded)
+
+        def scatter_chunk_grads(gp):
+            """ZeRO-2 half of fsdp: reduce-scatter this tick's full chunk
+            grads over 'data' so the accumulator carry stays sharded (the
+            scatter also performs the cross-replica grad sum for these
+            leaves — the epilogue skips its data-psum for them)."""
+            if not fsdp:
+                return gp
+            return jax.tree.map(
+                lambda g, sh: jax.lax.psum_scatter(
+                    g, DATA_AXIS, scatter_dimension=1, tiled=True)
+                if sh else g,
+                gp, fsdp_sharded)
+
         def masked_store(buf, reg, slot):
             active = slot >= 0
             ss = jnp.maximum(slot, 0)
@@ -503,7 +564,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 x_emb = stage_embed(embed, tokens_mb[mm], mm).astype(dtype)
                 x = jnp.where(first_stage, x_emb, act_buf[ss])
                 act_buf = act_buf.at[ss].set(x)  # saved for remat backward
-                y, _ = stage_body(select_v(layers_local, vv), x, vv, mm)
+                y, _ = stage_body(stage_params(vv), x, vv, mm)
                 return act_buf, y
 
             def fwd_noop(act_buf):
@@ -531,7 +592,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     last_stage = is_last_dev & (vv == last_chunk)
                     x = act_buf[jnp.maximum(row[COL_BWD_ASLOT], 0)]
                     g_in = grad_buf[jnp.maximum(row[COL_BWD_GSLOT], 0)]
-                    params_v = select_v(layers_local, vv)
+                    params_v = stage_params(vv)
                     (_, report), gx = jax.value_and_grad(
                         lambda x_in: stage_objective(params_v, head_bundle, x_in, vv,
                                                      mm, last_stage, g_in),
@@ -556,7 +617,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     first_stage = is_first_dev & (vv == 0)
                     x_slot = act_buf[jnp.maximum(row[COL_W_ASLOT], 0)]
                     g_in = grad_buf[jnp.maximum(row[COL_W_GSLOT], 0)]
-                    params_v = select_v(layers_local, vv)
+                    params_v = stage_params(vv)
                     (gp, gh, gx), _ = jax.grad(
                         lambda p_v, head_p, x_in: stage_objective(
                             p_v, head_p, x_in, vv, mm, last_stage, g_in),
@@ -567,6 +628,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                         # would duplicate the [vocab, dim] buffer per device)
                         gh, gh_embed = gh
                         g_embed = jax.tree.map(jnp.add, g_embed, gh_embed)
+                    gp = scatter_chunk_grads(gp)
                     g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
                                             g_layers, gp)
                     g_head = jax.tree.map(jnp.add, g_head, gh)
@@ -596,7 +658,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 first_stage = is_first_dev & (vv == 0)
                 x = act_buf[jnp.maximum(row[COL_BWD_ASLOT], 0)]
                 g_in = grad_buf[jnp.maximum(row[COL_BWD_GSLOT], 0)]
-                params_v = select_v(layers_local, vv)
+                params_v = stage_params(vv)
                 (_, report), (gp, gh, gx) = jax.value_and_grad(
                     lambda p_v, head_p, x_in: stage_objective(
                         p_v, head_p, x_in, vv, mm, last_stage, g_in),
@@ -607,6 +669,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     # accumulator (see wgrad_unit note)
                     gh, gh_embed = gh
                     g_embed = jax.tree.map(jnp.add, g_embed, gh_embed)
+                gp = scatter_chunk_grads(gp)
                 g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
                                         g_layers, gp)
                 g_head = jax.tree.map(jnp.add, g_head, gh)
@@ -665,9 +728,21 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         if n_data > 1:
             nd = 1.0 / n_data
             loss = jax.lax.psum(loss * nd, DATA_AXIS)
-            g_layers, g_embed, g_head = jax.tree.map(
-                lambda x: jax.lax.psum(x * nd, DATA_AXIS),
-                (g_layers, g_embed, g_head))
+            if fsdp:
+                # sharded layer leaves were already cross-replica summed by
+                # the per-tick psum_scatter — only the scale remains; a
+                # second psum here would n_data-fold them
+                g_layers = jax.tree.map(
+                    lambda x, sh: x * nd if sh
+                    else jax.lax.psum(x * nd, DATA_AXIS),
+                    g_layers, fsdp_sharded)
+                g_embed, g_head = jax.tree.map(
+                    lambda x: jax.lax.psum(x * nd, DATA_AXIS),
+                    (g_embed, g_head))
+            else:
+                g_layers, g_embed, g_head = jax.tree.map(
+                    lambda x: jax.lax.psum(x * nd, DATA_AXIS),
+                    (g_layers, g_embed, g_head))
         if n_seq > 1:
             # each seq shard holds its local-token share of d(global mean
             # loss)/d(params); the full grad is their unscaled sum (loss is
@@ -736,6 +811,13 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         # local shards and n_heads/T local heads.
         from .tensor_parallel import pipeline_layer_specs
         layer_spec = pipeline_layer_specs(cfg, PIPE_AXIS)
+    elif fsdp:
+        # stacked [D, V, lps, w0, ...]: w0 (the first weight dim) sharded
+        # over 'data' for matrix leaves; grads come back in the same layout
+        layer_spec = jax.tree.map(
+            lambda sh: P(PIPE_AXIS, None, None, DATA_AXIS) if sh
+            else P(PIPE_AXIS),
+            fsdp_sharded)
     else:
         layer_spec = P(PIPE_AXIS)
     if n_seq > 1:
@@ -794,6 +876,7 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                        force_tick_executor: bool = False, moe=None,
                        sp_attn_impl: str = "ring",
                        tp_vocab_parallel: bool = False,
+                       fsdp: bool = False,
                        ) -> Callable[[Pytree, jax.Array, jax.Array],
                                      Tuple[jax.Array, Pytree]]:
     """Jitted ``(params, tokens, targets) -> (loss, grads)`` pipeline step.
@@ -806,7 +889,40 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     """
     return jax.jit(make_pipeline_grad_fn(
         cfg, mesh, sched, force_tick_executor=force_tick_executor, moe=moe,
-        sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel))
+        sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel,
+        fsdp=fsdp))
+
+
+def fsdp_shard_params(params: Pytree, cfg: ModelConfig, mesh: Mesh) -> Pytree:
+    """Place a full-model pytree for pp x fsdp: layer leaves sharded over
+    'pipe' on the layer dim (each pipe device keeps only its stages) AND
+    over 'data' on the first weight dim for matrix leaves — the placement
+    the executor's grads come back in, so params, grads, and optimizer
+    state all rest at ~1/(D * n_data) of the model's layer weights per
+    device. Embed/head stay replicated (O(vocab*dim), a few percent of a
+    Llama-class model). With n_virtual > 1 the wrap placement's strided
+    stage->device map makes the per-step stacking a (small, sharded)
+    permute; with V=1 stacking is movement-free."""
+    from jax.sharding import NamedSharding
+    n_data = mesh.shape.get(DATA_AXIS, 1)
+    if n_data <= 1:
+        raise ValueError("fsdp_shard_params needs a 'data' mesh axis to "
+                         "shard parameters over (make_mesh(n_data=...))")
+    sharded = _fsdp_sharded_mask(cfg, n_data)
+
+    def put_layer(x, sh):
+        spec = (P(PIPE_AXIS, DATA_AXIS) if sh else P(PIPE_AXIS))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return {
+        "embed": jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P())),
+            params["embed"]),
+        "layers": jax.tree.map(put_layer, params["layers"], sharded),
+        "head": jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P())),
+            params["head"]),
+    }
 
 
 def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,

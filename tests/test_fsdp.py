@@ -44,6 +44,93 @@ def test_fsdp_memory_actually_sharded():
     assert gshard == {(16, 32)}
 
 
+def test_pp_fsdp_matches_single_device():
+    """pp x fsdp (VERDICT r1 item 6): per-stage layer weights sharded over
+    'data' with just-in-time all-gather per tick and per-tick
+    reduce-scatter of layer grads — loss/grads still equal single-device
+    autodiff."""
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+        make_mesh)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        fsdp_shard_params, make_pipeline_step)
+
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=32, arch="gpt2")
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (8, 16), 0, cfg.vocab_size)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(cfg, p, tokens, targets))(params)
+
+    mesh = make_mesh(n_pipe=2, n_data=2)
+    placed = fsdp_shard_params(params, cfg, mesh)
+    # layer matrices genuinely live pipe x data sharded between steps:
+    # [L=4, dim=32, ffn=64] -> per-device (L/2, dim/2, ffn)
+    w = placed["layers"]["lin1"]["w"]
+    assert {s.data.shape for s in w.addressable_shards} == {(2, 16, 64)}
+    for name, M in (("1F1B", 4), ("GPipe", 2)):
+        step = make_pipeline_step(
+            cfg, mesh, dtpp.ScheduleConfig(name=name, n_microbatches=M),
+            fsdp=True)
+        loss, grads = step(placed, tokens, targets)
+        assert float(jnp.abs(loss - ref_loss)) < 2e-5
+        err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                           grads, ref_grads)
+        assert max(jax.tree.leaves(err)) < 2e-5, name
+        # layer grads return in the same pipe x data sharded layout
+        # (ZeRO-2 per-tick reduce-scatter), so optimizer state inherits it
+        gw = grads["layers"]["lin1"]["w"]
+        assert {s.data.shape for s in gw.addressable_shards} == {(2, 16, 64)}
+
+
+def test_pp_fsdp_virtual_stages_and_split_backward():
+    """fsdp's per-tick gather/scatter under interleaved chunks and the
+    ZB-H1 split backward (dgrad + separate wgrad ticks)."""
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+        make_mesh)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        fsdp_shard_params, make_pipeline_step)
+
+    cfg = dtpp.ModelConfig(dim=32, n_layers=8, n_heads=4, vocab_size=64,
+                           ffn_dim=64)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 6), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (8, 6), 0, cfg.vocab_size)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(cfg, p, tokens, targets))(params)
+    mesh = make_mesh(n_pipe=2, n_data=2)
+    placed = fsdp_shard_params(params, cfg, mesh)
+    for name, V, M in (("Interleaved1F1B", 2, 4), ("ZBH1", 1, 4)):
+        step = make_pipeline_step(
+            cfg, mesh,
+            dtpp.ScheduleConfig(name=name, n_microbatches=M, n_virtual=V),
+            fsdp=True)
+        loss, grads = step(placed, tokens, targets)
+        assert float(jnp.abs(loss - ref_loss)) < 2e-5, name
+        err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                           grads, ref_grads)
+        assert max(jax.tree.leaves(err)) < 2e-5, name
+
+
+def test_pp_fsdp_validation():
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+        make_mesh)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        make_pipeline_step)
+    import pytest
+
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64)
+    with pytest.raises(ValueError, match="data"):
+        make_pipeline_step(cfg, make_mesh(n_pipe=2),
+                           dtpp.ScheduleConfig(name="GPipe",
+                                               n_microbatches=2), fsdp=True)
+    with pytest.raises(NotImplementedError, match="fsdp"):
+        make_pipeline_step(cfg, make_mesh(n_pipe=2, n_data=2, n_model=2),
+                           dtpp.ScheduleConfig(name="GPipe",
+                                               n_microbatches=2), fsdp=True)
+
+
 def test_zero1_opt_state_sharding_is_transparent():
     """ZeRO-1: sharding the optimizer state over 'data' changes placement,
     not numerics — a sharded-state run matches the replicated-state run."""
