@@ -19,6 +19,16 @@ This module is TPU-first by construction:
 Supports the ``gpt2`` and ``llama`` block families. ``ref_decoder`` is
 rejected: the reference model is non-causal with no positional encoding
 (SURVEY.md C2), so autoregressive decoding is semantically undefined for it.
+
+Scope note (deliberate): the decode loop runs single-device or GSPMD-TP
+(tests/test_generate.py::test_generate_with_tp_sharded_params), NOT over a
+pipeline mesh. Pipelining one-token decode steps is an anti-pattern — each
+step's compute is a sliver that cannot fill even one stage, so a pipe mesh
+would run at 1/D utilization by construction; batch inference over a pipe
+mesh is ``parallel.pipeline.make_pipeline_forward`` (fill-drain, V chunks
+supported), and eval losses on any dense training mesh are
+``make_pipeline_loss_fn``. For models too big for one chip at decode time,
+shard weights with TP (decode is bandwidth-bound; TP splits the reads).
 """
 
 from __future__ import annotations

@@ -135,21 +135,23 @@ def make_eval_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                  moe=None, sp_attn_impl: str = "ring",
                  tp_vocab_parallel: bool = False,
                  ) -> Callable[[Pytree, jax.Array, jax.Array], jax.Array]:
-    """Jitted eval-mode loss over the mesh. Dense data x pipe meshes use the
-    forward-only pipelined loss (no backward cost); any other configuration
-    falls back to the training grad function — built with the SAME
-    parallelization knobs as the train step — with the gradients discarded
-    (still eval-mode: no rng is threaded, so dropout is off)."""
-    from ..parallel.mesh import DATA_AXIS as _DA, PIPE_AXIS as _PA
+    """Jitted eval-mode loss over the mesh. Every dense training mesh
+    (data x pipe x model x seq, any n_virtual, incl. vocab-parallel CE)
+    uses the forward-only pipelined loss (no backward cost); MoE falls back
+    to the training grad function — built with the SAME parallelization
+    knobs as the train step — with the gradients discarded (still
+    eval-mode: no rng is threaded, so dropout is off)."""
+    from ..parallel.mesh import EXPERT_AXIS as _EA, PIPE_AXIS as _PA
     from ..parallel.pipeline import make_pipeline_loss_fn
 
-    dense_dp_pp = (moe is None and sched.n_virtual == 1 and all(
-        mesh.shape.get(ax, 1) == 1 or ax in (_DA, _PA)
-        for ax in mesh.shape))
-    if dense_dp_pp and cfg.n_layers % mesh.shape[_PA] == 0:
+    dense = moe is None and mesh.shape.get(_EA, 1) == 1
+    S = mesh.shape[_PA] * sched.n_virtual
+    if dense and cfg.n_layers % S == 0:
         eval_cfg = (dataclasses.replace(cfg, dropout=0.0)
                     if cfg.dropout else cfg)
-        return make_pipeline_loss_fn(eval_cfg, mesh, sched)
+        return make_pipeline_loss_fn(eval_cfg, mesh, sched,
+                                     sp_attn_impl=sp_attn_impl,
+                                     tp_vocab_parallel=tp_vocab_parallel)
     grad_fn = make_pipeline_grad_fn(
         dataclasses.replace(cfg, dropout=0.0), mesh, sched, moe=moe,
         sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel)

@@ -28,6 +28,15 @@ def main():
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--pipe", type=int, default=1)
     ap.add_argument("--data", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="model-axis size (Megatron TP inside stages)")
+    ap.add_argument("--sp", type=int, default=1,
+                    help="seq-axis size (ring/Ulysses sequence parallelism)")
+    ap.add_argument("--sp-attn", default="ring", choices=["ring", "ulysses"])
+    ap.add_argument("--virtual", type=int, default=1,
+                    help="virtual chunks per device (wrap placement)")
+    ap.add_argument("--vocab-parallel", action="store_true",
+                    help="Megatron vocab-parallel CE over the model axis")
     ap.add_argument("--microbatches", type=int, default=1)
     ap.add_argument("--dim", type=int, default=0)
     ap.add_argument("--ffn", type=int, default=0)
@@ -96,10 +105,33 @@ def main():
         params = restore_checkpoint(path, template=params_t)
     print(f"loaded {path}", flush=True)
 
-    mesh = make_mesh(n_pipe=args.pipe, n_data=args.data)
+    mesh = make_mesh(n_pipe=args.pipe, n_data=args.data, n_model=args.tp,
+                     n_seq=args.sp)
+    # the checkpoint's arrays carry their TRAINING-time placement (e.g. a
+    # 2-device pipe mesh); re-place onto the eval mesh so the jitted loss
+    # accepts them whatever mesh it spans. Under --tp the layer matrices go
+    # straight to their Megatron shards (no full per-device replica spike —
+    # the point of TP eval for models that don't fit one chip); otherwise
+    # replicated.
+    from jax.sharding import NamedSharding, PartitionSpec
+    if args.tp > 1:
+        from distributed_training_with_pipeline_parallelism_tpu.parallel.tensor_parallel import (
+            param_specs)
+        specs = param_specs(cfg)
+        if cfg.tie_embeddings:
+            specs["head"].pop("out")  # tied head has no out leaf
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+    else:
+        params = jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, PartitionSpec())),
+            params)
     sched = dtpp.ScheduleConfig(name="GPipe",
-                                n_microbatches=args.microbatches)
-    eval_fn = train.make_eval_fn(cfg, mesh, sched)
+                                n_microbatches=args.microbatches,
+                                n_virtual=args.virtual)
+    eval_fn = train.make_eval_fn(cfg, mesh, sched, sp_attn_impl=args.sp_attn,
+                                 tp_vocab_parallel=args.vocab_parallel)
     data = TokenFileDataset(args.data_file, args.seq, seed=123).batches(
         args.batch)
     metrics = train.evaluate(eval_fn, params, data, args.batches)

@@ -72,6 +72,8 @@ SMOKE_NODES = (
     # sweep harness contracts (no timed runs)
     "tests/test_sweep.py::test_bfs_virtual_stage_rule",
     "tests/test_sweep.py::test_error_contract",
+    # 2-process jax.distributed rendezvous + cross-process pipeline step
+    "tests/test_multihost.py::test_init_multihost_two_process_pipeline",
 )
 
 

@@ -52,13 +52,77 @@ def test_eval_fn_ignores_dropout(problem):
 
 
 def test_eval_fn_fallback_meshes(problem):
-    # virtual stages force the grad-fn fallback; loss must still match
+    # virtual stages now take the forward-only path too; loss must match
     params, tokens, targets, ref = problem
     eval_fn = make_eval_fn(
         CFG, make_mesh(n_pipe=2),
         dtpp.ScheduleConfig(name="Interleaved1F1B", n_microbatches=4,
                             n_virtual=2))
     assert abs(float(eval_fn(params, tokens, targets)) - ref) < 1e-5
+
+
+@pytest.mark.parametrize("V,M", [(2, 4), (4, 2), (2, 2)])
+def test_pipeline_loss_virtual_stages(problem, V, M):
+    """Forward-only eval over V wrap-placed chunks (VERDICT r1 item 7):
+    the BFS fill-drain table covers V > 1 without a backward."""
+    params, tokens, targets, ref = problem
+    loss_fn = make_pipeline_loss_fn(
+        CFG, make_mesh(n_pipe=2),
+        dtpp.ScheduleConfig(name="GPipe", n_microbatches=M, n_virtual=V))
+    assert abs(float(loss_fn(params, tokens, targets)) - ref) < 1e-5
+
+
+def test_pipeline_loss_tp_and_sp_meshes(problem):
+    """Forward-only eval on TP and SP training meshes, incl. the
+    vocab-parallel CE (tied and untied) — no grad-fn fallback."""
+    params, tokens, targets, ref = problem
+    # pp x tp
+    loss_fn = make_pipeline_loss_fn(
+        CFG, make_mesh(n_pipe=2, n_model=2),
+        dtpp.ScheduleConfig(name="GPipe", n_microbatches=2))
+    assert abs(float(loss_fn(params, tokens, targets)) - ref) < 1e-5
+    # pp x tp with Megatron vocab-parallel CE (vocab 50 % 2 == 0)
+    loss_fn = make_pipeline_loss_fn(
+        CFG, make_mesh(n_pipe=2, n_model=2),
+        dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
+        tp_vocab_parallel=True)
+    assert abs(float(loss_fn(params, tokens, targets)) - ref) < 1e-5
+    # pp x sp (ring) and x dp
+    loss_fn = make_pipeline_loss_fn(
+        CFG, make_mesh(n_pipe=2, n_data=2, n_seq=2),
+        dtpp.ScheduleConfig(name="GPipe", n_microbatches=2))
+    assert abs(float(loss_fn(params, tokens, targets)) - ref) < 1e-5
+
+
+def test_pipeline_loss_tied_vocab_parallel():
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, arch="gpt2", max_seq_len=16,
+                           tie_embeddings=True)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 8), 0, 64)
+    targets = jax.random.randint(jax.random.key(2), (8, 8), 0, 64)
+    ref = float(tfm.transformer_loss(cfg, params, tokens, targets))
+    loss_fn = make_pipeline_loss_fn(
+        cfg, make_mesh(n_pipe=2, n_model=2),
+        dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
+        tp_vocab_parallel=True)
+    assert abs(float(loss_fn(params, tokens, targets)) - ref) < 1e-5
+
+
+def test_pipeline_forward_virtual_stages():
+    """Batch-inference logits with V > 1 chunks match the full forward."""
+    import numpy as np
+
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        make_pipeline_forward)
+    params = tfm.transformer_init(jax.random.key(0), CFG)
+    tokens = jax.random.randint(jax.random.key(1), (8, 6), 0, CFG.vocab_size)
+    want = np.asarray(tfm.transformer_apply(CFG, params, tokens))
+    fwd = make_pipeline_forward(
+        CFG, make_mesh(n_pipe=2),
+        dtpp.ScheduleConfig(name="GPipe", n_microbatches=2, n_virtual=2))
+    got = np.asarray(jax.device_get(fwd(params, tokens)))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
 
 
 def test_evaluate_aggregates(problem):
