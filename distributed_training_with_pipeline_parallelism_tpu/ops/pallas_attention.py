@@ -336,16 +336,31 @@ def _flash_vjp_bwd(causal, block_q, block_k, window, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _auto_block(s: int) -> int:
+    """Default kernel block: 512 measured fastest on v5e at seq 1024-4096
+    (up to ~20% fwd / ~34% grad over 256; grad@2048 within noise —
+    docs/performance.md), EXCEPT when 256 divides the sequence and 512
+    does not: then 512 would pad a dead 256-row block (+20% wasted
+    compute at s=1280) that 256 avoids entirely."""
+    if s % 512 != 0 and s % 256 == 0:
+        return 256
+    return 512
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = False, block_q: int = 256,
-                    block_k: int = 256,
+                    causal: bool = False, block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     window: Optional[int] = None) -> jax.Array:
     """Fused attention: q, k, v [batch, seq, heads, head_dim] -> same shape.
 
     Drop-in replacement for the dense attention inside
     ``ops.attention.mha_apply`` (GQA repeat must happen before the call);
     differentiable with a fully-blockwise Pallas backward (see module
-    docstring). ``window`` (requires ``causal``) applies the Mistral
+    docstring). ``block_q``/``block_k`` default to :func:`_auto_block`
+    (512, or 256 where it avoids a dead padding block); both kernels keep
+    one [block_q, block_k] f32 tile plus the full per-(batch, head) K/V
+    in VMEM, so block size trades tile-reuse against grid parallelism,
+    not memory. ``window`` (requires ``causal``) applies the Mistral
     sliding-window band: both directions skip K/V (resp. Q) blocks entirely
     outside ``[i - window + 1, i]``, so long-sequence *compute* scales with
     the window. K/V VMEM residency still scales with the sequence (the
@@ -355,6 +370,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if window is not None and (not causal or window < 1):
         raise ValueError("window requires causal attention and window >= 1")
     b, s, h, dh = q.shape
+    block_q = block_q or _auto_block(s)
+    block_k = block_k or _auto_block(s)
 
     def flat(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, dh)
