@@ -339,10 +339,11 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 def _auto_block(s: int) -> int:
     """Default kernel block: 512 measured fastest on v5e at seq 1024-4096
     (up to ~20% fwd / ~34% grad over 256; grad@2048 within noise —
-    docs/performance.md), EXCEPT when 256 divides the sequence and 512
-    does not: then 512 would pad a dead 256-row block (+20% wasted
-    compute at s=1280) that 256 avoids entirely."""
-    if s % 512 != 0 and s % 256 == 0:
+    docs/performance.md) — EXCEPT where it pads more dead rows than 256
+    would (e.g. s=1280: 512 pads to 1536, 256 pads nothing; s=1100: both
+    pad, 256 to 1280 vs 512 to 1536). Pick the block minimizing the
+    padded length, ties to 512."""
+    if -(-s // 256) * 256 < -(-s // 512) * 512:
         return 256
     return 512
 

@@ -1,0 +1,131 @@
+"""Rebuild notebooks/experiments.ipynb cell sources (round 2).
+
+Adds the Part-1 schedule-timeline figures (the reference's cells 4/7/9/11,
+rendered exactly from compiled tick tables), a full-sweep artifact section
+that displays results/sweep.csv (the committed 54-config run), and the
+ordering-reconciliation analysis. Run, then execute the notebook:
+
+    python scripts/update_notebook.py
+    jupyter nbconvert --to notebook --execute --inplace \
+        notebooks/experiments.ipynb --ExecutePreprocessor.timeout=3600
+"""
+
+import json
+import os
+import sys
+
+NB = os.path.join(os.path.dirname(__file__), "..", "notebooks",
+                  "experiments.ipynb")
+
+
+def md(src):
+    return {"cell_type": "markdown", "metadata": {}, "source": src}
+
+
+def code(src):
+    return {"cell_type": "code", "metadata": {}, "source": src,
+            "outputs": [], "execution_count": None}
+
+
+def main():
+    nb = json.load(open(NB))
+    cells = nb["cells"]
+    if any("committed full 54-config artifact" in "".join(c["source"])
+           for c in cells):
+        print("notebook already rebuilt (marker cell present) — refusing a "
+              "second splice; restore from git first to re-run")
+        return 1
+
+    timeline_md = md(
+        "The reference's Part 1 carries four hand-drawn schedule diagrams "
+        "(its cells 4/7/9/11, embedded PNGs). Here the same figures are "
+        "*generated from the compiled tick tables the executor actually "
+        "runs* — exact for any (schedule, D, V, M), bubbles included, and "
+        "they extend to the beyond-parity schedules (ZB-H1 shown; BFS/ZB-V "
+        "render the same way):")
+    timeline_code = code(
+        "from distributed_training_with_pipeline_parallelism_tpu.utils.plotting "
+        "import plot_schedule_timeline\n"
+        "for name, D, V, M in [(\"GPipe\", 4, 1, 4), (\"1F1B\", 4, 1, 4),\n"
+        "                      (\"Interleaved1F1B\", 4, 2, 8), (\"ZBH1\", 4, 1, 8)]:\n"
+        "    plot_schedule_timeline(name, D, V, M);")
+
+    full_md = md(
+        "### The committed full 54-config artifact\n\n"
+        "The full cross product (plus beyond-parity schedule columns) runs "
+        "for hours on a simulated CPU mesh, so it is executed by "
+        "`python scripts/run_sweep.py --simulate-devices 8` and committed "
+        "under `results/`; this section displays the committed artifact. "
+        "**Caveat for interpreting the wall-clock numbers**: this dev host "
+        "has ONE CPU core, so the 8 simulated devices serialize — elapsed "
+        "time measures total work plus per-tick overhead, not pipeline "
+        "overlap, and schedules with more ticks (interleaved: 2x) pay more "
+        "overhead. The behavioral orderings are reconciled with the "
+        "reference's published table via the tick-model cost simulations "
+        "below and in `docs/results.md`.")
+    full_code = code(
+        "import os, pandas as pd\n"
+        "full = None\n"
+        "if os.path.exists(\"../results/sweep.csv\"):\n"
+        "    full = pd.read_csv(\"../results/sweep.csv\")\n"
+        "    print(f\"{len(full)} committed rows\")\n"
+        "    display(pivot_throughput(full).round(1))\n"
+        "    display(compute_speedup_and_efficiency(full).round(3))\n"
+        "else:\n"
+        "    print(\"results/sweep.csv not committed yet — run scripts/run_sweep.py\")")
+    full_plots = code(
+        "if full is not None:\n"
+        "    plot_speedup_and_efficiency(compute_speedup_and_efficiency(full));\n"
+        "    plot_throughput_grid(full);")
+
+    analysis_md = md(
+        "## Analysis — reconciling the orderings with the reference\n\n"
+        "The reference's published orderings (BASELINE.md: Interleaved wins "
+        "where `n_layers % (devices*2) == 0`, else it degenerates to 1F1B's "
+        "layout; 1F1B ≈ GPipe) are properties of its **runtime cost "
+        "model**: async per-device progress (torch processes advance "
+        "independently through batched P2P) and stashed activations "
+        "(backward ≈ 2 forward-equivalents). `schedules.async_makespan` "
+        "simulates exactly that model on our tick orders and reproduces "
+        "every published ordering (tested in "
+        "`tests/test_schedules.py::test_async_model_reproduces_reference_orderings`).\n\n"
+        "This framework's executor makes two different choices — lockstep "
+        "ticks (one compiled program, `ppermute` barriers) and a "
+        "rematerializing backward (≈ 3 forward-equivalents) — so its "
+        "predicted orderings differ *by design*: mixed F/B ticks pay the "
+        "barrier (GPipe's homogeneous phases do not), quantified by "
+        "`simulated_bubble(w_b=3)`. On this one-core host a third term "
+        "dominates both: all \"parallel\" devices share a single core, so "
+        "wall-clock ≈ total work + per-tick dispatch overhead — schedules "
+        "with more ticks (interleaved: 2× at V=2) measure slower "
+        "regardless of bubble. The cells below show both models; "
+        "`docs/results.md` carries the full table and the committed "
+        "artifact's numbers.")
+    analysis_code = code(
+        "from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules "
+        "import predicted_throughput, compile_schedule, simulated_bubble\n"
+        "import pandas as pd\n"
+        "rows = []\n"
+        "for D in (2, 4):\n"
+        "    gp_async = predicted_throughput(\"GPipe\", D, 1, 4, 1.0)\n"
+        "    gp_lock = 1 - simulated_bubble(compile_schedule(\"GPipe\", D, 1, 4))[\"bubble_fraction\"]\n"
+        "    for name, V in [(\"GPipe\", 1), (\"1F1B\", 1), (\"Interleaved1F1B\", 2), (\"Interleaved1F1B\", 1)]:\n"
+        "        lock = 1 - simulated_bubble(compile_schedule(name, D, V, 4))[\"bubble_fraction\"]\n"
+        "        rows.append({\"D\": D, \"schedule\": f\"{name}/V{V}\",\n"
+        "                     \"async_stash (reference model)\": round(predicted_throughput(name, D, V, 4, 1.0) / gp_async, 3),\n"
+        "                     \"lockstep_remat (this executor)\": round(lock / gp_lock, 3)})\n"
+        "pd.DataFrame(rows).set_index([\"D\", \"schedule\"])")
+
+    # rebuild: keep 0-4 (Part 1), insert timelines after cell 3's printout,
+    # keep 5-10 (quick sweep + plots), add full-artifact section, replace
+    # the analysis tail
+    new_cells = (cells[:4] + [timeline_md, timeline_code] + cells[4:11]
+                 + [full_md, full_code, full_plots, analysis_md,
+                    analysis_code])
+    nb["cells"] = new_cells
+    json.dump(nb, open(NB, "w"), indent=1)
+    print(f"wrote {len(new_cells)} cells")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
