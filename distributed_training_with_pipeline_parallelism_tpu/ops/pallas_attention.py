@@ -337,13 +337,12 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def _auto_block(s: int) -> int:
-    """Default kernel block: 512 measured fastest on v5e at seq 1024-4096
-    (up to ~20% fwd / ~34% grad over 256; grad@2048 within noise —
-    docs/performance.md) — EXCEPT where it pads more dead rows than 256
-    would (e.g. s=1280: 512 pads to 1536, 256 pads nothing; s=1100: both
-    pad, 256 to 1280 vs 512 to 1536). Pick the block minimizing the
-    padded length, ties to 512."""
-    if -(-s // 256) * 256 < -(-s // 512) * 512:
+    """Default kernel block: 512 measured up to ~20% (fwd) / ~34% (grad)
+    faster per row than 256 on v5e at seq 1024-4096 (docs/performance.md).
+    Estimated time ~ padded_length / per-row-speed, so 256 wins only where
+    its padding saving exceeds 512's ~1.2x per-row advantage (s=1280:
+    1280 vs 1536/1.2 -> 256; s=2600: 2816 vs 3072/1.2 -> 512)."""
+    if -(-s // 256) * 256 * 1.2 <= -(-s // 512) * 512:
         return 256
     return 512
 

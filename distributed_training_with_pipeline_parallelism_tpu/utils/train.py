@@ -32,16 +32,21 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     optimizer: optax.GradientTransformation, moe=None,
                     sp_attn_impl: str = "ring",
                     tp_vocab_parallel: bool = False,
+                    fsdp: bool = False,
                     ) -> Callable[[Pytree, Any, jax.Array, jax.Array],
                                   Tuple[Pytree, Any, jax.Array]]:
     """Jitted ``(params, opt_state, tokens, targets) ->
     (params, opt_state, loss)``: pipeline grads + optax update in one XLA
     program (so the update fuses with the grad psum epilogue). ``moe``
     (a MoEConfig) selects MoE pipeline stages — see
-    :func:`..parallel.pipeline.make_pipeline_grad_fn`."""
+    :func:`..parallel.pipeline.make_pipeline_grad_fn`. ``fsdp`` runs
+    ZeRO-3 inside the pipeline (params placed via ``fsdp_shard_params``;
+    grads come back in the same pipe x data layout, so the optax update —
+    elementwise — runs shard-local and moments are born sharded)."""
     grad_fn = make_pipeline_grad_fn(cfg, mesh, sched, moe=moe,
                                     sp_attn_impl=sp_attn_impl,
-                                    tp_vocab_parallel=tp_vocab_parallel)
+                                    tp_vocab_parallel=tp_vocab_parallel,
+                                    fsdp=fsdp)
 
     if cfg.dropout > 0.0:
         # train-mode dropout: the step takes a per-step PRNG key
@@ -133,7 +138,7 @@ def adamw(learning_rate: float = 3e-4, weight_decay: float = 0.01,
 
 def make_eval_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                  moe=None, sp_attn_impl: str = "ring",
-                 tp_vocab_parallel: bool = False,
+                 tp_vocab_parallel: bool = False, fsdp: bool = False,
                  ) -> Callable[[Pytree, jax.Array, jax.Array], jax.Array]:
     """Jitted eval-mode loss over the mesh. Every dense training mesh
     (data x pipe x model x seq, any n_virtual, incl. vocab-parallel CE)
@@ -151,10 +156,12 @@ def make_eval_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     if cfg.dropout else cfg)
         return make_pipeline_loss_fn(eval_cfg, mesh, sched,
                                      sp_attn_impl=sp_attn_impl,
-                                     tp_vocab_parallel=tp_vocab_parallel)
+                                     tp_vocab_parallel=tp_vocab_parallel,
+                                     fsdp=fsdp)
     grad_fn = make_pipeline_grad_fn(
         dataclasses.replace(cfg, dropout=0.0), mesh, sched, moe=moe,
-        sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel)
+        sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel,
+        fsdp=fsdp)
 
     @jax.jit
     def loss_only(params, tokens, targets):
@@ -213,7 +220,7 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         resume: bool = False, skip_data_on_resume: bool = True,
         metrics_path: Optional[str] = None, moe=None,
         sp_attn_impl: str = "ring", tp_vocab_parallel: bool = False,
-        zero1: bool = False, dropout_seed: int = 0,
+        zero1: bool = False, fsdp: bool = False, dropout_seed: int = 0,
         eval_data: Optional[Callable[[], Iterator]] = None,
         eval_every: int = 0, eval_batches: int = 8,
         profile_dir: Optional[str] = None,
@@ -264,8 +271,19 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         optimizer = optax.MultiSteps(optimizer, every_k_schedule=grad_accum)
     step_fn = make_train_step(cfg, mesh, sched, optimizer, moe=moe,
                               sp_attn_impl=sp_attn_impl,
-                              tp_vocab_parallel=tp_vocab_parallel)
-    if zero1:
+                              tp_vocab_parallel=tp_vocab_parallel,
+                              fsdp=fsdp)
+    if fsdp and zero1:
+        raise ValueError("fsdp already shards optimizer state (ZeRO-3 "
+                         "subsumes ZeRO-1) — drop --zero1")
+    if fsdp:
+        # pp x fsdp (ZeRO-3 in-pipeline): params rest pipe x data sharded;
+        # the elementwise optax init/update inherits that layout through
+        # jit, so moments are born sharded with no extra machinery
+        from ..parallel.pipeline import fsdp_shard_params
+        params = fsdp_shard_params(params, cfg, mesh)
+        opt_state = jax.jit(optimizer.init)(params)
+    elif zero1:
         # init directly INTO the sharded layout: the replicated moments
         # never materialize, so the ZeRO-1 memory ceiling holds at init too
         opt_state = init_sharded_opt_state(optimizer, params, mesh)
@@ -304,7 +322,8 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
     if eval_data is not None and eval_every:
         eval_fn = make_eval_fn(cfg, mesh, sched, moe=moe,
                                sp_attn_impl=sp_attn_impl,
-                               tp_vocab_parallel=tp_vocab_parallel)
+                               tp_vocab_parallel=tp_vocab_parallel,
+                               fsdp=fsdp)
 
     def _eval(i):
         m = evaluate(eval_fn, params, eval_data(), eval_batches)

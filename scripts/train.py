@@ -40,6 +40,11 @@ def main():
                     help="sequence-parallel attention transport")
     ap.add_argument("--zero1", action="store_true",
                     help="ZeRO-1: shard optimizer state over the data axis")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="pp x fsdp (ZeRO-3 in-pipeline): layer params rest "
+                         "pipe x data sharded with just-in-time chunk "
+                         "gathers; grads/moments inherit the sharding "
+                         "(needs --data > 1; dense meshes only)")
     ap.add_argument("--vocab-parallel", action="store_true",
                     help="Megatron parallel cross-entropy: vocab-shard the "
                          "head over the --tp model axis (logits never "
@@ -301,7 +306,7 @@ def main():
         checkpoint_every=(args.ckpt_every or args.steps) if args.ckpt else 0,
         resume=args.auto_resume, metrics_path=args.metrics or None, moe=moe,
         sp_attn_impl=args.sp_attn, tp_vocab_parallel=args.vocab_parallel,
-        zero1=args.zero1, dropout_seed=args.seed,
+        zero1=args.zero1, fsdp=args.fsdp, dropout_seed=args.seed,
         eval_data=eval_data, eval_every=args.eval_every,
         eval_batches=args.eval_batches,
         profile_dir=args.profile or None, grad_accum=args.grad_accum)

@@ -116,10 +116,11 @@ def main():
         "                     \"lockstep_remat (this executor)\": round(lock / gp_lock, 3)})\n"
         "pd.DataFrame(rows).set_index([\"D\", \"schedule\"])")
 
-    # rebuild: keep 0-4 (Part 1), insert timelines after cell 3's printout,
-    # keep 5-10 (quick sweep + plots), add full-artifact section, replace
-    # the analysis tail
-    new_cells = (cells[:4] + [timeline_md, timeline_code] + cells[4:11]
+    # rebuild: keep 0-4 (Part 1 incl. the memory-note markdown that
+    # comments on cell 3's printout — timelines go AFTER it so the prose
+    # stays adjacent to its table), keep 5-10 (quick sweep + plots), add
+    # the full-artifact section, replace the analysis tail
+    new_cells = (cells[:5] + [timeline_md, timeline_code] + cells[5:11]
                  + [full_md, full_code, full_plots, analysis_md,
                     analysis_code])
     nb["cells"] = new_cells
