@@ -21,7 +21,10 @@ import pandas as pd
 
 def _mpl():
     import matplotlib
-    matplotlib.use("Agg")
+    # headless default — but do NOT clobber a notebook's inline backend,
+    # or executed notebooks silently lose every figure
+    if "inline" not in matplotlib.get_backend().lower():
+        matplotlib.use("Agg")
     import matplotlib.pyplot as plt
     return plt
 

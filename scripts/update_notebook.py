@@ -35,6 +35,11 @@ def main():
         print("notebook already rebuilt (marker cell present) — refusing a "
               "second splice; restore from git first to re-run")
         return 1
+    # figures need the inline backend under nbconvert --execute, or every
+    # plot call silently renders nothing (round-1 notebook had no images)
+    setup = cells[2]
+    if "%matplotlib inline" not in "".join(setup["source"]):
+        setup["source"] = "%matplotlib inline\n" + "".join(setup["source"])
 
     timeline_md = md(
         "The reference's Part 1 carries four hand-drawn schedule diagrams "

@@ -30,6 +30,14 @@ jax.config.update("jax_platforms", "cpu")
 # (The "XLA:CPU AOT ... machine feature not supported on the host" warnings
 # on this virtualized host are the contributing smell: visible CPU features
 # differ between compile and load.)
+#
+# RELATED (round 2): even without the cache, XLA:CPU can SIGSEGV inside
+# backend_compile after a few hundred compilations in ONE process (observed
+# twice at ~88% of the full suite, in jax compiler.py
+# backend_compile_and_load; the same test passes in a fresh interpreter).
+# If a full `pytest tests/` run segfaults deep in, split it into two
+# processes (e.g. alphabetically) rather than chasing the crash — it is an
+# XLA:CPU process-longevity issue, not a test bug. `-m smoke` is unaffected.
 if "tempfile" in dir():  # keep the import satisfied for future use
     pass
 
