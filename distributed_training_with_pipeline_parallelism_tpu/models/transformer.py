@@ -109,8 +109,9 @@ def layer_apply(cfg: ModelConfig, params: Dict, h: jax.Array,
         # TP: its mask is the global mask's local slice (oracle-exact)
         ff = _ffn_out(params["lin2"],
                       sharded_dropout_apply(
-                          jax.nn.relu(linear_apply(params["lin1"],
-                                                   _tp_in(x, tp_axis))),
+                          jax.checkpoint(jax.nn.relu)(
+                              linear_apply(params["lin1"],
+                                           _tp_in(x, tp_axis))),
                           p, site(4), axis=tp_axis, n_shards=tp_size,
                           shard_dim=-1),
                       tp_axis)
@@ -157,16 +158,23 @@ def mlp_block(cfg: ModelConfig, params: Dict, h: jax.Array,
     Shared between the training path (:func:`layer_apply`) and the KV-cache
     decode path (:mod:`.generate`, which never passes an rng) so the two
     cannot drift. ``rng`` applies residual-branch dropout to the MLP output."""
+    # the activations are checkpointed: backward saves only the [.., ffn]
+    # pre-activation and recomputes the (tanh-)gelu/silu chain — without
+    # this autodiff banks ~6 ffn-sized intermediates per layer, the
+    # dominant residual cost of stored-activation backwards
     if cfg.arch == "gpt2":
         m = _tp_in(layer_norm_apply(params["ln2"], h), tp_axis)
         ff = _ffn_out(params["lin2"],
-                      jax.nn.gelu(linear_apply(params["lin1"], m)),
+                      jax.checkpoint(jax.nn.gelu)(
+                          linear_apply(params["lin1"], m)),
                       tp_axis)
         return h + dropout_apply(ff, dropout, rng)
     m = _tp_in(rms_norm_apply(params["rms2"], h, cfg.rms_eps), tp_axis)
     act = jax.nn.silu if cfg.mlp_act == "silu" else jax.nn.gelu
     ff = _ffn_out(params["w2"],
-                  act(linear_apply(params["w1"], m)) * linear_apply(params["w3"], m),
+                  jax.checkpoint(lambda a, b: act(a) * b)(
+                      linear_apply(params["w1"], m),
+                      linear_apply(params["w3"], m)),
                   tp_axis)
     return h + dropout_apply(ff, dropout, rng)
 

@@ -123,7 +123,13 @@ def scaled_dot_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    # checkpoint: saves only the [b,h,s,t] scores for backward (the f32
+    # softmax output and its compute-dtype copy — 3x the scores bytes —
+    # are recomputed, a pointwise cost). Cuts every stored-activation
+    # path's residual traffic; the flash kernel path never builds these.
+    probs = jax.checkpoint(
+        lambda s: jax.nn.softmax(s.astype(jnp.float32),
+                                 axis=-1).astype(q.dtype))(scores)
     if head_shard is not None and head_shard[1] > 1:
         probs = sharded_dropout_apply(probs, dropout_rate, dropout_rng,
                                       axis=head_shard[0],

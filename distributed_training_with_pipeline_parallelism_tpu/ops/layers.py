@@ -37,10 +37,17 @@ def layer_norm_init(dim: int) -> Dict:
 
 
 def layer_norm_apply(params: Dict, x: jax.Array, eps: float = 1e-5) -> jax.Array:
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    xn = (x - mean) * jax.lax.rsqrt(var + eps)
-    return xn * params["scale"] + params["bias"]
+    # checkpoint: backward saves only (x, scale, bias) and recomputes the
+    # stats — without it autodiff banks the f32 normalized copy (2-4x the
+    # input bytes at bf16 compute), the single largest residual class in
+    # the stored-activation profiles (docs/performance.md)
+    def core(scale, bias, x):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        xn = (x - mean) * jax.lax.rsqrt(var + eps)
+        return xn * scale + bias
+
+    return jax.checkpoint(core)(params["scale"], params["bias"], x)
 
 
 def rms_norm_init(dim: int) -> Dict:
@@ -48,8 +55,12 @@ def rms_norm_init(dim: int) -> Dict:
 
 
 def rms_norm_apply(params: Dict, x: jax.Array, eps: float = 1e-5) -> jax.Array:
-    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
-    return x * jax.lax.rsqrt(ms + eps) * params["scale"]
+    # checkpointed for the same residual-traffic reason as layer_norm_apply
+    def core(scale, x):
+        ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        return x * jax.lax.rsqrt(ms + eps) * scale
+
+    return jax.checkpoint(core)(params["scale"], x)
 
 
 def embedding_init(key: jax.Array, vocab: int, dim: int) -> jax.Array:

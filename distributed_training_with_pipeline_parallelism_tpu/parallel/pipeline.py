@@ -224,6 +224,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                           sp_attn_impl: str = "ring",
                           tp_vocab_parallel: bool = False,
                           fsdp: bool = False,
+                          remat_backward=None,
                           ) -> Callable[[Pytree, jax.Array, jax.Array],
                                         Tuple[jax.Array, Pytree]]:
     """Build an (unjitted) ``(params, tokens, targets) -> (loss, grads)``
@@ -242,6 +243,35 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     ``B`` divisible by (n_data * n_microbatches); the batch is split over the
     'data' mesh axis, then into microbatches along dim 0 (upstream
     ``DEFAULT_CHUNK_DIM=0``, ``microbatch.py:57``).
+
+    ``remat_backward`` selects the backward's activation policy (measured
+    policy table in docs/performance.md "Backward policy"):
+
+    - ``None`` (default, auto): at D == 1 (incl. pure data/tensor/seq
+      meshes and the benchmark's ``force_tick_executor`` runs), the
+      UNROLLED stored program — microbatches as straight-line code,
+      autodiff residuals managed and fused by XLA; measured the fastest
+      single-chip formulation. At D > 1, the REMATERIALIZING backward:
+      on TPU the backward's stage-forward recompute costs ~1.33x FLOPs on
+      the MXU, which measures cheaper than pushing stored residuals
+      through HBM scan boundaries at both the reference config and
+      gpt2-small seq 1024.
+    - ``True``: always rematerialize — the forward unit saves only the
+      stage *input*; the backward recomputes the stage forward. Minimal
+      activation memory (O(in-flight) stage inputs).
+    - ``False``: stored-activation backward — nothing is recomputed,
+      matching the reference's torch-autograd semantics (its backward
+      stashes, never recomputes — ``LLMsDistributedTrainingHelper.py:
+      98-143`` via upstream ``stage.py:857/937``). Phase-separated
+      schedules (GPipe/BFS: per-device all-F-then-all-B) differentiate
+      through the forward tick scan (:func:`_make_phase_stored_grad_fn`);
+      other schedules bank the stage body's ``jax.vjp`` residuals in
+      slot-addressed buffers (x-independent residuals — weights, casts,
+      RoPE — are re-derived live instead of stored, see
+      :mod:`.stored_backward`). Raises on configurations that cannot
+      support it (split-backward schedules, whose W units re-derive
+      parameter grads by design; ``fsdp=True``, where residuals would pin
+      the just-in-time-gathered full weights).
 
     ``fsdp=True`` (pp x fsdp, ZeRO-3 within the pipeline): per-stage layer
     weights live sharded over the 'data' axis (first weight dim split
@@ -345,6 +375,50 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
         return degenerate_step
     split = cs.split_backward  # ZB-H1 family: B is dgrad-only, W carries wgrad
+    # Backward-policy resolution, from v5e measurements (docs/performance.md
+    # "Backward policy"):
+    #
+    # - D == 1 (any non-split schedule — every schedule's grads are
+    #   order-independent and the table is device-symmetric): the UNROLLED
+    #   stored program — straight-line microbatch code, autodiff residuals
+    #   fused by XLA. Measured fastest (no scan boundary).
+    # - D > 1: REMATERIALIZING backward by default. Stored variants
+    #   (scan-vjp for phase-separated GPipe/BFS, slot-buffer residual
+    #   banking otherwise) are opt-in via remat_backward=False: on TPU the
+    #   backward's stage-forward recompute rides the MXU at ~1.33x FLOPs
+    #   while stored residuals ride HBM through scan boundaries — measured
+    #   SLOWER than remat at both the reference config and gpt2-small
+    #   seq 1024 on one chip. (The reference's torch-CPU runtime has the
+    #   opposite economics, hence its stash-don't-recompute backward.)
+    # - Split-backward schedules and fsdp always rematerialize (W's
+    #   recompute fills bubbles by design; fsdp residuals would pin
+    #   gathered full weights).
+    phase_ok = (not split and cs.placement == "wrap" and moe is None
+                and not fsdp
+                and (D == 1 or sched.name in ("GPipe", "BFS")))
+    if remat_backward is None:
+        use_phase = phase_ok and D == 1
+        use_stored = False
+    elif remat_backward:
+        use_phase = use_stored = False
+    else:
+        if split:
+            raise ValueError(
+                f"remat_backward=False is incompatible with split-backward "
+                f"schedule {sched.name!r}: its W units re-derive parameter "
+                f"grads from saved inputs by design (that recompute is what "
+                f"fills the bubble ticks)")
+        if fsdp:
+            raise ValueError(
+                "remat_backward=False is incompatible with fsdp=True: the "
+                "stage body's residuals would pin each tick's just-in-time "
+                "all-gathered full weights per in-flight microbatch, "
+                "voiding the ZeRO-3 residency bound")
+        use_phase = phase_ok
+        use_stored = not phase_ok
+    if use_phase:
+        return _make_phase_stored_grad_fn(cfg, mesh, sched, sp_attn_impl,
+                                          tp_vocab_parallel)
     table = jnp.asarray(cs.table)  # [T, D, N_COLS]
     dtype = jnp.dtype(cfg.dtype)
     fwd_perm = [(i, (i + 1) % D) for i in range(D)]
@@ -529,6 +603,31 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             report = jnp.where(last_stage, main, 0.0) + aux_term
             return main + aux_term, report
 
+        if use_stored:
+            # Stored-activation backward: classify the stage body's vjp
+            # residuals once (abstract trace; vv/mm/x are arguments so the
+            # jaxpr matches the live units', where they are tracers) and
+            # allocate slot buffers for the x-dependent leaves only — the
+            # x-independent ones (casts of weights, RoPE tables, masks) are
+            # re-derived live at backward. See stored_backward module doc.
+            from .stored_backward import (check_residual_leaves,
+                                          x_dependent_mask)
+
+            def body_vjp_leaves(p_v, x_in, vv, mm):
+                _, vjp_fn = jax.vjp(
+                    lambda p, xi: stage_body(p, xi, vv, mm), p_v, x_in)
+                return tuple(jax.tree.leaves(vjp_fn))
+
+            _mask_args = (select_v(layers_local, 0),
+                          jnp.zeros(mb_shape, dtype),
+                          jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+            res_mask = x_dependent_mask(body_vjp_leaves, _mask_args, (1,))
+            res_struct = jax.eval_shape(body_vjp_leaves, *_mask_args)
+            stored_struct = tuple(
+                s for s, m0 in zip(res_struct, res_mask) if m0)
+        else:
+            res_mask = stored_struct = res_struct = ()
+
         def run_unit(pred, unit, noop, operand):
             """Execute one schedule unit. Default: a lax.cond (idle devices
             take the cheap branch; psum/all_to_all inside are grouped, so a
@@ -557,7 +656,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     jax.lax.ppermute(bwd_send, PIPE_AXIS, fwd_perm))
 
         def tick(carry, row_all):
-            (act_buf, grad_buf, recvs,
+            (act_buf, grad_buf, res_bufs, recvs,
              g_layers, g_embed, g_head, loss_acc) = carry
             row = row_all[d]
 
@@ -573,21 +672,63 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             # 2. forward unit
             fv, fm, fslot = row[COL_FWD_V], row[COL_FWD_M], row[COL_FWD_SLOT]
 
-            def fwd_unit(act_buf):
-                vv, mm = jnp.maximum(fv, 0), jnp.maximum(fm, 0)
-                ss = jnp.maximum(fslot, 0)
-                first_stage = is_first_dev & (vv == 0)
-                x_emb = stage_embed(embed, tokens_mb[mm], mm).astype(dtype)
-                x = jnp.where(first_stage, x_emb, act_buf[ss])
-                act_buf = act_buf.at[ss].set(x)  # saved for remat backward
-                y, _ = stage_body(stage_params(vv), x, vv, mm)
-                return act_buf, y
+            if use_stored:
+                # Buffer discipline (measured on v5e): the slot-buffer
+                # writes live INSIDE the cond — only the taken branch
+                # touches them, so idle ticks cost nothing. (The
+                # alternative — cond returns the leaves, masked_store
+                # outside — materializes slot-sized zeros every idle tick
+                # and re-writes every active tick: measured 1.4x slower.)
+                def fwd_unit(op):
+                    act_buf, res_bufs, loss_acc = op
+                    vv, mm = jnp.maximum(fv, 0), jnp.maximum(fm, 0)
+                    ss = jnp.maximum(fslot, 0)
+                    first_stage = is_first_dev & (vv == 0)
+                    x_emb = stage_embed(embed, tokens_mb[mm],
+                                        mm).astype(dtype)
+                    x = jnp.where(first_stage, x_emb, act_buf[ss])
+                    (y, aux), vjp_fn = jax.vjp(
+                        lambda p, xi: stage_body(p, xi, vv, mm),
+                        stage_params(vv), x)
+                    leaves, _ = jax.tree.flatten(vjp_fn)
+                    check_residual_leaves(leaves, res_struct, "forward")
+                    stored = (l for l, m0 in zip(leaves, res_mask) if m0)
+                    res_bufs = tuple(
+                        b.at[ss].set(l) for b, l in zip(res_bufs, stored))
+                    # the slot banks the body OUTPUT (the backward's head
+                    # input on the last stage); x is spent — same lifetime,
+                    # same slot, no extra buffer
+                    act_buf = act_buf.at[ss].set(y)
+                    # the MoE routing aux share of the reported loss is
+                    # known at forward time here (the CE share lands in the
+                    # backward unit); the remat path accumulates both at
+                    # backward — the totals are identical
+                    return (act_buf, res_bufs,
+                            loss_acc + aux * aux_scale), y
 
-            def fwd_noop(act_buf):
-                return act_buf, jnp.zeros(mb_shape, dtype)
+                def fwd_noop(op):
+                    return op, jnp.zeros(mb_shape, dtype)
 
-            act_buf, fwd_send = run_unit(fm >= 0, fwd_unit, fwd_noop,
-                                         act_buf)
+                (act_buf, res_bufs, loss_acc), fwd_send = run_unit(
+                    fm >= 0, fwd_unit, fwd_noop,
+                    (act_buf, res_bufs, loss_acc))
+            else:
+                def fwd_unit(act_buf):
+                    vv, mm = jnp.maximum(fv, 0), jnp.maximum(fm, 0)
+                    ss = jnp.maximum(fslot, 0)
+                    first_stage = is_first_dev & (vv == 0)
+                    x_emb = stage_embed(embed, tokens_mb[mm],
+                                        mm).astype(dtype)
+                    x = jnp.where(first_stage, x_emb, act_buf[ss])
+                    act_buf = act_buf.at[ss].set(x)  # saved for remat bwd
+                    y, _ = stage_body(stage_params(vv), x, vv, mm)
+                    return act_buf, y
+
+                def fwd_noop(act_buf):
+                    return act_buf, jnp.zeros(mb_shape, dtype)
+
+                act_buf, fwd_send = run_unit(fm >= 0, fwd_unit, fwd_noop,
+                                             act_buf)
             if reverse_routes:
                 # same-device hop (vshape's V turning point): the output IS
                 # the next chunk's input — bank it locally, no ring transit
@@ -664,10 +805,82 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     wm >= 0, wgrad_unit, lambda op: op,
                     (g_layers, g_embed, g_head))
 
-                return (act_buf, grad_buf, transfers(fwd_send, bwd_send),
+                return (act_buf, grad_buf, res_bufs,
+                        transfers(fwd_send, bwd_send),
                         g_layers, g_embed, g_head, loss_acc), None
 
-            def bwd_unit(operand):
+            def bwd_unit_stored(operand):
+                """Stored-activation backward: head+CE grads from live
+                weights and the banked body output y; body grads by
+                replaying the banked vjp residuals (x-independent leaves
+                re-derived live — the dummy-x forward chain is dead code
+                XLA eliminates). No stage forward is recomputed."""
+                g_layers, g_embed, g_head, loss_acc = operand
+                vv, mm = jnp.maximum(bv, 0), jnp.maximum(bm, 0)
+                last_stage = is_last_dev & (vv == last_chunk)
+                first_stage = is_first_dev & (vv == 0)
+                aslot = jnp.maximum(row[COL_BWD_ASLOT], 0)
+                y = act_buf[aslot]
+                g_in = grad_buf[jnp.maximum(row[COL_BWD_GSLOT], 0)]
+                params_v = stage_params(vv)
+
+                def head_obj(head_arg, yy):
+                    head_arg = compute_cast(cfg, head_arg)
+                    if cfg.tie_embeddings:
+                        head_p, embed_p = head_arg
+                    else:
+                        head_p, embed_p = head_arg, None
+                    return _stage_ce(
+                        cfg, head_p, embed_p, yy, targets_mb[mm],
+                        tp_axis=tp_axis, T=T,
+                        tp_vocab_parallel=tp_vocab_parallel,
+                        pad_scale=pad_scale if cfg.pad_token_id is not None
+                        else None,
+                        loss_norm=loss_norm)
+
+                def last_branch():
+                    ce, (gh_d, ct_y) = jax.value_and_grad(
+                        head_obj, argnums=(0, 1))(head_bundle, y)
+                    return gh_d, ct_y, ce
+
+                def other_branch():
+                    return (jax.tree.map(jnp.zeros_like, head_bundle),
+                            g_in, jnp.zeros((), jnp.float32))
+
+                gh, ct_y, ce = jax.lax.cond(last_stage, last_branch,
+                                            other_branch)
+                # replay the banked residuals: re-trace the SAME vjp with a
+                # dummy x, take x-independent leaves fresh, banked otherwise
+                _, vjp2 = jax.vjp(
+                    lambda p, xi: stage_body(p, xi, vv, mm), params_v,
+                    jnp.zeros(mb_shape, dtype))
+                fresh, treedef2 = jax.tree.flatten(vjp2)
+                check_residual_leaves(fresh, res_struct, "backward")
+                banked = iter(res_bufs)
+                sel = [next(banked)[aslot] if m0 else f
+                       for m0, f in zip(res_mask, fresh)]
+                gp, gx = jax.tree.unflatten(treedef2, sel)(
+                    (ct_y, jnp.asarray(aux_scale, jnp.float32)))
+
+                if cfg.tie_embeddings:
+                    gh, gh_embed = gh
+                    g_embed = jax.tree.map(jnp.add, g_embed, gh_embed)
+                g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
+                                        g_layers, gp)
+                g_head = jax.tree.map(jnp.add, g_head, gh)
+                g_embed = jax.lax.cond(
+                    first_stage,
+                    lambda: jax.tree.map(
+                        jnp.add, g_embed,
+                        jax.grad(lambda e: jnp.vdot(
+                            stage_embed(e, tokens_mb[mm],
+                                        mm).astype(jnp.float32),
+                            gx.astype(jnp.float32)))(embed)),
+                    lambda: g_embed)
+                loss_acc = loss_acc + ce
+                return (g_layers, g_embed, g_head, loss_acc), gx
+
+            def bwd_unit_remat(operand):
                 g_layers, g_embed, g_head, loss_acc = operand
                 vv, mm = jnp.maximum(bv, 0), jnp.maximum(bm, 0)
                 last_stage = is_last_dev & (vv == last_chunk)
@@ -704,21 +917,24 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 return operand, jnp.zeros(mb_shape, dtype)
 
             (g_layers, g_embed, g_head, loss_acc), bwd_send = run_unit(
-                bm >= 0, bwd_unit, bwd_noop,
-                (g_layers, g_embed, g_head, loss_acc))
+                bm >= 0, bwd_unit_stored if use_stored else bwd_unit_remat,
+                bwd_noop, (g_layers, g_embed, g_head, loss_acc))
             if reverse_routes:
                 grad_buf = masked_store(grad_buf, bwd_send,
                                         row[COL_BWD_LOCAL_SLOT])
 
             # 4. ring transfer: activations +1, gradients -1 (ICI hops);
             # vshape placements add the two reverse channels
-            return (act_buf, grad_buf, transfers(fwd_send, bwd_send),
+            return (act_buf, grad_buf, res_bufs,
+                    transfers(fwd_send, bwd_send),
                     g_layers, g_embed, g_head, loss_acc), None
 
         n_chan = 4 if reverse_routes else 2
         carry0 = (
             jnp.zeros((cs.n_act_slots,) + mb_shape, dtype),
             jnp.zeros((cs.n_grad_slots,) + mb_shape, dtype),
+            tuple(jnp.zeros((cs.n_act_slots,) + s.shape, s.dtype)
+                  for s in stored_struct),
             tuple(jnp.zeros(mb_shape, dtype) for _ in range(n_chan)),
             jax.tree.map(jnp.zeros_like, layers_local),
             jax.tree.map(jnp.zeros_like, embed),
@@ -726,7 +942,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             jnp.zeros((), jnp.float32),
         )
         carry, _ = jax.lax.scan(tick, carry0, table)
-        (_, _, _, g_layers, g_embed, g_head, loss_acc) = carry
+        (_, _, _, _, g_layers, g_embed, g_head, loss_acc) = carry
 
         # Reductions: loss lives on the last stage only; embed/head grads on
         # one device each — psum replicates them across 'pipe'. Scale by 1/M
@@ -893,20 +1109,27 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                        sp_attn_impl: str = "ring",
                        tp_vocab_parallel: bool = False,
                        fsdp: bool = False,
+                       remat_backward=None,
                        ) -> Callable[[Pytree, jax.Array, jax.Array],
                                      Tuple[jax.Array, Pytree]]:
     """Jitted ``(params, tokens, targets) -> (loss, grads)`` pipeline step.
 
     Matching the reference's measurement semantics (SURVEY.md §3.3 note): the
     step computes loss and gradients only — no optimizer update — so it can be
-    timed exactly like ``schedule.step``. ``force_tick_executor`` keeps the
-    tick program even in the degenerate 1-device case (used by bubble
-    measurement, where the comparator must pay the same remat cost).
+    timed exactly like ``schedule.step``. ``force_tick_executor`` disables
+    the degenerate 1-device fast path (a single fused full-batch step that
+    ignores microbatching), so the step really executes the compiled
+    schedule's microbatch program; WHICH executor formulation runs it is
+    chosen by ``remat_backward`` (see :func:`make_pipeline_grad_fn` — at
+    D == 1 the default is the unrolled stored program; pass
+    ``remat_backward=True`` for the rematerializing tick scan, as
+    ``utils.profiling.measure_bubble`` does for its cost-matched
+    comparator).
     """
     return jax.jit(make_pipeline_grad_fn(
         cfg, mesh, sched, force_tick_executor=force_tick_executor, moe=moe,
         sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel,
-        fsdp=fsdp))
+        fsdp=fsdp, remat_backward=remat_backward))
 
 
 def fsdp_shard_params(params: Pytree, cfg: ModelConfig, mesh: Mesh) -> Pytree:
@@ -985,39 +1208,44 @@ def _fwd_tick_table(D: int, V: int, M: int):
     return table, max(n_slots, 1)
 
 
-def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
-                          sp_attn_impl: str = "ring",
-                          tp_vocab_parallel: bool = False,
-                          fsdp: bool = False,
-                          ) -> Callable[[Pytree, jax.Array, jax.Array],
-                                        jax.Array]:
-    """Jitted forward-only eval loss: ``(params, tokens, targets) -> loss``.
+def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
+                           sched: ScheduleConfig, sp_attn_impl: str,
+                           tp_vocab_parallel: bool, fsdp: bool,
+                           train_dropout: bool = False,
+                           unroll: bool = False):
+    """The forward-only tick program (BFS fill-drain over
+    ``sched.n_virtual`` wrap-placed chunks; every schedule's forward order
+    is fill-drain) shared by the eval loss (:func:`make_pipeline_loss_fn`)
+    and the phase-separated stored backward (autodiff THROUGH this scan —
+    see :func:`make_pipeline_grad_fn`). The last stage computes the
+    token-mean CE per microbatch and accumulates it; [B, S, V] logits never
+    materialize.
 
-    The evaluation twin of :func:`make_pipeline_grad_fn` — a forward-only
-    tick program (BFS fill-drain over ``sched.n_virtual`` wrap-placed
-    chunks; the schedule *name* is irrelevant to a forward pass) where the
-    last stage computes the token-mean CE per microbatch (eval mode: no
-    dropout) and accumulates it instead of materializing [B, S, V] logits.
-    The mean over microbatches equals the single-device full-batch
-    ``transformer_loss`` exactly (asserted in tests/test_eval.py), at
-    forward-only cost — no backward, no rematerialization.
+    ``unroll`` (requires D == 1, where the table is device-symmetric so
+    every row is compile-time concrete): emit the ticks as a static Python
+    loop instead of a ``lax.scan`` — no slot buffers, no conds, no scan
+    boundary, so XLA fuses across microbatches. Measured 148k vs 107k
+    tok/s for the same 4-microbatch program on one v5e chip: scan
+    boundaries force every residual through HBM, which is the dominant
+    cost of microbatched training at small per-microbatch shapes
+    (docs/performance.md).
 
-    Covers the full dense training-mesh space (VERDICT r1 item 7): data x
-    pipe x model x seq meshes, V >= 1, Megatron TP inside stages,
-    ring/Ulysses sequence parallelism, the vocab-parallel CE
-    (``tp_vocab_parallel`` — incl. tied embeddings), and pp x fsdp
-    resting layouts (``fsdp=True``: params arrive pipe x data sharded and
-    each chunk is gathered just in time, preserving the ZeRO-3 residency
-    bound during eval). MoE stages are the remaining scope cut (their
-    eval loss needs an aux-term convention).
-    """
+    Returns ``(spmd_fn, in_specs, D, V)`` where ``spmd_fn(layers_stacked,
+    embed, head, tokens, targets[, rng_data])`` -> per-device partial loss
+    (the PIPE/SEQ/DATA reductions are left to the caller so its gradient —
+    taken inside shard_map — comes out as per-device partials, mirroring
+    the tick executor's epilogue). With ``train_dropout`` the function
+    takes the step key's raw data and draws the executor's exact mask
+    streams (fold_in(step key, microbatch) then global-layer offsets), so
+    a phase-separated stored-backward step equals the slot-buffer
+    executor's bit-for-tolerance."""
     D = mesh.shape[PIPE_AXIS]
     n_data = mesh.shape.get(DATA_AXIS, 1)
     T = mesh.shape.get(MODEL_AXIS, 1)
     n_seq = mesh.shape.get(SEQ_AXIS, 1)
     if mesh.shape.get(EXPERT_AXIS, 1) > 1:
         raise NotImplementedError(
-            "make_pipeline_loss_fn does not run MoE/expert stages")
+            "the forward tick program does not run MoE/expert stages")
     if fsdp and (n_data <= 1 or T > 1 or n_seq > 1):
         raise ValueError("fsdp eval needs a dense data x pipe mesh "
                          "(matching the training-side pp x fsdp support)")
@@ -1047,18 +1275,22 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         raise ValueError(f"n_layers={cfg.n_layers} must divide over {S} stages")
     lps = cfg.n_layers // S
     uniform_units = sp_axis is not None and sp_attn_impl == "ring"
+    if unroll and D != 1:
+        raise ValueError("unroll=True requires a 1-device pipe axis (the "
+                         "table is only device-symmetric at D == 1)")
     table_np, n_slots = _fwd_tick_table(D, V, M)
     table = jnp.asarray(table_np)
     dtype = jnp.dtype(cfg.dtype)
     fwd_perm = [(i, (i + 1) % D) for i in range(D)]
     loss_norm = n_seq
 
-    def spmd_fn(layers_stacked, embed, head, tokens, targets):
+    def spmd_fn(layers_stacked, embed, head, tokens, targets,
+                rng_data=None):
         d = jax.lax.axis_index(PIPE_AXIS)
         layers_local = compute_cast(
             cfg, jax.tree.map(lambda x: x[0], layers_stacked))
-        embed = compute_cast(cfg, embed)
-        head = compute_cast(cfg, head)
+        embed_c = compute_cast(cfg, embed)
+        head_c = compute_cast(cfg, head)
         b_local, seq = tokens.shape
         assert b_local % M == 0, (
             f"local batch {b_local} not divisible by n_microbatches={M}")
@@ -1067,7 +1299,19 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         targets_mb = targets.reshape(M, mb, seq)
         mb_shape = (mb, seq, cfg.dim)
 
-        def stage_body(vv, x):
+        if train_dropout:
+            base_rng = jax.random.wrap_key_data(rng_data)
+            if n_data > 1:
+                base_rng = jax.random.fold_in(
+                    base_rng, jax.lax.axis_index(DATA_AXIS))
+        else:
+            base_rng = None
+
+        def mb_rng(mm):
+            return (None if base_rng is None
+                    else jax.random.fold_in(base_rng, mm))
+
+        def stage_body(vv, x, mm=0):
             layer_p = jax.tree.map(
                 lambda t: jax.lax.dynamic_index_in_dim(t, vv, 0,
                                                        keepdims=False),
@@ -1079,18 +1323,27 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     lambda x_, sh: jax.lax.all_gather(
                         x_, DATA_AXIS, axis=1, tiled=True) if sh else x_,
                     layer_p, fsdp_sharded)
+            offset = (vv * D + d) * lps  # wrap placement's global layer
             if sp_axis is None:
-                return body_apply(cfg, layer_p, x, tp_axis=tp_axis, tp_size=T)
+                return body_apply(cfg, layer_p, x, tp_axis=tp_axis,
+                                  tp_size=T, rng=mb_rng(mm),
+                                  layer_offset=offset)
             from .seq_parallel import sp_body_apply
             return sp_body_apply(cfg, layer_p, x, sp_axis,
                                  attn_impl=sp_attn_impl,
-                                 tp_axis=tp_axis, tp_size=T)
+                                 tp_axis=tp_axis, tp_size=T,
+                                 rng=mb_rng(mm), layer_offset=offset,
+                                 sp_size=n_seq)
 
-        def stage_embed(toks):
+        def stage_embed(toks, mm=0):
+            rng_mb = mb_rng(mm)
+            rng_e = (None if rng_mb is None
+                     else jax.random.fold_in(rng_mb, cfg.n_layers))
             if sp_axis is None:
-                return embed_apply(cfg, embed, toks)
+                return embed_apply(cfg, embed_c, toks, rng=rng_e)
             from .seq_parallel import sp_embed_apply
-            return sp_embed_apply(cfg, embed, toks, sp_axis)
+            return sp_embed_apply(cfg, embed_c, toks, sp_axis, rng=rng_e,
+                                  sp_size=n_seq)
 
         if cfg.pad_token_id is not None:
             shard_axes = (SEQ_AXIS,) if n_seq > 1 else None
@@ -1101,11 +1354,37 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
         def mb_loss(y, mm):
             return _stage_ce(
-                cfg, head, embed, y, targets_mb[mm], tp_axis=tp_axis, T=T,
-                tp_vocab_parallel=tp_vocab_parallel,
+                cfg, head_c, embed_c, y, targets_mb[mm], tp_axis=tp_axis,
+                T=T, tp_vocab_parallel=tp_vocab_parallel,
                 pad_scale=pad_scale if cfg.pad_token_id is not None
                 else None,
                 loss_norm=loss_norm)
+
+        if unroll:
+            # D == 1: every table row is concrete, so the tick loop lowers
+            # to straight-line code — slots become Python variables, conds
+            # become Python ifs, the self-loop ppermute disappears
+            saved: dict = {}
+            recv = None
+            loss = jnp.zeros((), jnp.float32)
+            for t in range(table_np.shape[0]):
+                s0, fv_, fm_, src = (int(v) for v in table_np[t, 0])
+                if s0 >= 0:
+                    assert recv is not None, "forward table banks a value " \
+                        "no prior tick sent"
+                    saved[s0] = recv
+                if fm_ < 0:
+                    recv = None
+                    continue
+                if fv_ == 0:
+                    x = stage_embed(tokens_mb[fm_], fm_).astype(dtype)
+                else:
+                    x = saved[src]
+                y = stage_body(fv_, x, fm_)
+                if fv_ == V - 1:
+                    loss = loss + mb_loss(y, fm_)
+                recv = y
+            return loss / M
 
         masked_store = _masked_store
 
@@ -1124,10 +1403,10 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             def fwd_unit(act_buf):
                 vv, mm = jnp.maximum(fv, 0), jnp.maximum(fm, 0)
                 first_stage = (d == 0) & (vv == 0)
-                x_emb = stage_embed(tokens_mb[mm]).astype(dtype)
+                x_emb = stage_embed(tokens_mb[mm], mm).astype(dtype)
                 x = jnp.where(first_stage, x_emb,
                               act_buf[jnp.maximum(src, 0)])
-                y = stage_body(vv, x)
+                y = stage_body(vv, x, mm)
                 last_stage = (d == D - 1) & (vv == V - 1)
                 l = jax.lax.cond(last_stage, lambda: mb_loss(y, mm),
                                  lambda: jnp.zeros((), jnp.float32))
@@ -1145,12 +1424,7 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                   jnp.zeros(mb_shape, dtype),
                   jnp.zeros((), jnp.float32))
         (_, _, loss), _ = jax.lax.scan(tick, carry0, table)
-        loss = jax.lax.psum(loss, PIPE_AXIS) / M  # lives on the last stage
-        if n_seq > 1:
-            loss = jax.lax.psum(loss, SEQ_AXIS)
-        if n_data > 1:
-            loss = jax.lax.psum(loss / n_data, DATA_AXIS)
-        return loss
+        return loss / M  # per-device partial (non-last stages: 0)
 
     if T > 1:
         from .tensor_parallel import pipeline_layer_specs
@@ -1170,12 +1444,50 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     else:
         head_spec = P()
     batch_spec = P(DATA_AXIS, SEQ_AXIS) if n_seq > 1 else P(DATA_AXIS)
+    in_specs = (layer_spec, P(), head_spec, batch_spec, batch_spec)
+    return spmd_fn, in_specs, D, V
 
-    sharded = _shard_map(
-        spmd_fn, mesh,
-        in_specs=(layer_spec, P(), head_spec, batch_spec, batch_spec),
-        out_specs=P(),
-    )
+
+def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
+                          sp_attn_impl: str = "ring",
+                          tp_vocab_parallel: bool = False,
+                          fsdp: bool = False,
+                          ) -> Callable[[Pytree, jax.Array, jax.Array],
+                                        jax.Array]:
+    """Jitted forward-only eval loss: ``(params, tokens, targets) -> loss``.
+
+    The evaluation twin of :func:`make_pipeline_grad_fn` — the forward
+    tick program of :func:`_build_forward_program` (eval mode: no dropout)
+    with the cross-device loss reductions applied. The mean over
+    microbatches equals the single-device full-batch ``transformer_loss``
+    exactly (asserted in tests/test_eval.py), at forward-only cost — no
+    backward, no rematerialization.
+
+    Covers the full dense training-mesh space (VERDICT r1 item 7): data x
+    pipe x model x seq meshes, V >= 1, Megatron TP inside stages,
+    ring/Ulysses sequence parallelism, the vocab-parallel CE
+    (``tp_vocab_parallel`` — incl. tied embeddings), and pp x fsdp
+    resting layouts (``fsdp=True``: params arrive pipe x data sharded and
+    each chunk is gathered just in time, preserving the ZeRO-3 residency
+    bound during eval). MoE stages are the remaining scope cut (their
+    eval loss needs an aux-term convention).
+    """
+    spmd_fn, in_specs, D, V = _build_forward_program(
+        cfg, mesh, sched, sp_attn_impl, tp_vocab_parallel, fsdp)
+    n_data = mesh.shape.get(DATA_AXIS, 1)
+    n_seq = mesh.shape.get(SEQ_AXIS, 1)
+
+    def reduced(layers_stacked, embed, head, tokens, targets):
+        loss = jax.lax.psum(
+            spmd_fn(layers_stacked, embed, head, tokens, targets),
+            PIPE_AXIS)  # lives on the last stage
+        if n_seq > 1:
+            loss = jax.lax.psum(loss, SEQ_AXIS)
+        if n_data > 1:
+            loss = jax.lax.psum(loss / n_data, DATA_AXIS)
+        return loss
+
+    sharded = _shard_map(reduced, mesh, in_specs=in_specs, out_specs=P())
 
     @jax.jit
     def loss_fn(params, tokens, targets):
@@ -1184,6 +1496,92 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                        tokens, targets)
 
     return loss_fn
+
+
+def _make_phase_stored_grad_fn(cfg: ModelConfig, mesh: Mesh,
+                               sched: ScheduleConfig, sp_attn_impl: str,
+                               tp_vocab_parallel: bool):
+    """Stored-activation backward for phase-separated schedules (GPipe,
+    BFS — and ANY non-split schedule at D == 1): differentiate THROUGH
+    the forward tick program.
+
+    These schedules run, per device, every forward before any backward —
+    so the backward tick order is exactly the time-reversal of the forward
+    program, which is precisely what ``jax.value_and_grad`` produces: XLA
+    banks each tick's residuals (as static scan outputs at D > 1; as
+    ordinary fused SSA values in the D == 1 unrolled program), the
+    generated backward replays them in reverse, and the transposed
+    ``ppermute`` IS the gradient ring (+1 forward ring transposes to the
+    -1 grad ring). This matches the reference's torch-autograd semantics
+    exactly (GPipe's backward stashes per-microbatch saved tensors and
+    never recomputes — upstream ``schedules.py:872-992`` over
+    ``stage.py:857/937``). Activation residency is O(M) microbatches —
+    GPipe's own requirement; schedules whose point is O(D) residency
+    (1F1B/Interleaved) interleave B among F and cannot use this path at
+    D > 1. Single-chip measurements (v5e, docs/performance.md): the
+    unrolled D == 1 form is the FASTEST executor formulation (~1.25x over
+    the remat tick scan); the scanned D > 1 form measures SLOWER than
+    remat (scan-boundary residual traffic), hence it is opt-in via
+    ``remat_backward=False``.
+    """
+    use_dropout = cfg.dropout > 0.0
+    spmd_fn, in_specs, D, V = _build_forward_program(
+        cfg, mesh, sched, sp_attn_impl, tp_vocab_parallel, False,
+        train_dropout=use_dropout,
+        unroll=mesh.shape[PIPE_AXIS] == 1)
+    n_data = mesh.shape.get(DATA_AXIS, 1)
+    n_seq = mesh.shape.get(SEQ_AXIS, 1)
+
+    def grad_prog(layers_stacked, embed, head, tokens, targets,
+                  rng_data=None):
+        def obj(ls, e, h):
+            if use_dropout:
+                return spmd_fn(ls, e, h, tokens, targets, rng_data)
+            return spmd_fn(ls, e, h, tokens, targets)
+
+        loss, (g_l, g_e, g_h) = jax.value_and_grad(
+            obj, argnums=(0, 1, 2))(layers_stacked, embed, head)
+        # same reduction epilogue as the tick executor: loss lives on the
+        # last stage; replicated embed/head grads are per-device partials
+        loss = jax.lax.psum(loss, PIPE_AXIS)
+        g_e = jax.tree.map(lambda x: jax.lax.psum(x, PIPE_AXIS), g_e)
+        g_h = jax.tree.map(lambda x: jax.lax.psum(x, PIPE_AXIS), g_h)
+        if n_seq > 1:
+            loss = jax.lax.psum(loss, SEQ_AXIS)
+            g_l, g_e, g_h = jax.tree.map(
+                lambda x: jax.lax.psum(x, SEQ_AXIS), (g_l, g_e, g_h))
+        if n_data > 1:
+            nd = 1.0 / n_data
+            loss = jax.lax.psum(loss * nd, DATA_AXIS)
+            g_l, g_e, g_h = jax.tree.map(
+                lambda x: jax.lax.psum(x * nd, DATA_AXIS),
+                (g_l, g_e, g_h))
+        return loss, g_l, g_e, g_h
+
+    grad_specs = in_specs + ((P(),) if use_dropout else ())
+    sharded = _shard_map(
+        grad_prog, mesh, in_specs=grad_specs,
+        out_specs=(P(), in_specs[0], P(), in_specs[2]))
+
+    def unpack(loss, g_l, g_e, g_h):
+        return loss, {"embed": g_e,
+                      "layers": unstack_stage_layers(g_l),
+                      "head": g_h}
+
+    if use_dropout:
+        def step(params, tokens, targets, rng):
+            stacked = stack_stage_layers(params["layers"], D, V)
+            return unpack(*sharded(stacked, params["embed"],
+                                   params["head"], tokens, targets,
+                                   jax.random.key_data(rng)))
+        return step
+
+    def step(params, tokens, targets):
+        stacked = stack_stage_layers(params["layers"], D, V)
+        return unpack(*sharded(stacked, params["embed"], params["head"],
+                               tokens, targets))
+
+    return step
 
 
 def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,

@@ -85,8 +85,9 @@ def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
                     tp_axis=tp_axis, dropout_rate=p, dropout_rng=site(2))
         x = layer_norm_apply(params["ln2"], x + drop(ca, 3))
         ff = _ffn_out(params["lin2"],
-                      drop(jax.nn.relu(linear_apply(params["lin1"],
-                                                    _tp_in(x, tp_axis))), 4),
+                      drop(jax.checkpoint(jax.nn.relu)(
+                          linear_apply(params["lin1"],
+                                       _tp_in(x, tp_axis))), 4),
                       tp_axis)
         return layer_norm_apply(params["ln3"], x + drop(ff, 5))
     if cfg.arch == "gpt2":
@@ -97,7 +98,8 @@ def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
         h = h + drop(attn, 1)
         m = _tp_in(layer_norm_apply(params["ln2"], h), tp_axis)
         ff = _ffn_out(params["lin2"],
-                      jax.nn.gelu(linear_apply(params["lin1"], m)),
+                      jax.checkpoint(jax.nn.gelu)(
+                          linear_apply(params["lin1"], m)),
                       tp_axis)
         return h + drop(ff, 2)
     if cfg.arch == "llama":
@@ -109,8 +111,9 @@ def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
         m = _tp_in(rms_norm_apply(params["rms2"], h, cfg.rms_eps), tp_axis)
         act = jax.nn.silu if cfg.mlp_act == "silu" else jax.nn.gelu
         ff = _ffn_out(params["w2"],
-                      act(linear_apply(params["w1"], m))
-                      * linear_apply(params["w3"], m),
+                      jax.checkpoint(lambda a, b: act(a) * b)(
+                          linear_apply(params["w1"], m),
+                          linear_apply(params["w3"], m)),
                       tp_axis)
         return h + drop(ff, 2)
     raise ValueError(f"unknown arch {cfg.arch!r}")

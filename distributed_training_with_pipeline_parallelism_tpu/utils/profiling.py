@@ -90,10 +90,13 @@ def measure_bubble(cfg, mesh, sched, batch_size: int = 32,
     single_mesh = make_mesh(n_pipe=1, devices=list(mesh.devices.flat)[:1])
     single_sched = ScheduleConfig(name="GPipe",
                                   n_microbatches=sched.n_microbatches)
-    # force the tick executor so the comparator pays the same remat cost as
-    # the pipeline run (the degenerate-case fast path skips remat entirely)
+    # force the tick executor AND the rematerializing backward so the
+    # comparator pays the same per-unit costs as the D-device pipeline run
+    # (the degenerate fast path skips remat entirely, and the D=1 default
+    # is the unrolled stored program — either would skew the ratio)
     single_step = make_pipeline_step(cfg, single_mesh, single_sched,
-                                     force_tick_executor=True)
+                                     force_tick_executor=True,
+                                     remat_backward=True)
     t_single = _time_fn(single_step, params, tokens, targets, iters=iters)
 
     cs = compile_schedule(sched.name, D, sched.n_virtual, sched.n_microbatches)

@@ -39,9 +39,20 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                        dim: int = 768, vocab_size: int = 10000,
                        n_microbatches: int = 4, seed: int = 0,
                        arch: str = "ref_decoder",
-                       dtype: str = "float32") -> Dict[str, float]:
+                       dtype: str = "float32",
+                       remat_backward=None) -> Dict[str, float]:
     """Run one pipeline experiment; returns the reference's metrics dict plus
-    bubble analytics, or ``{"error": ...}`` on failure."""
+    bubble analytics, or ``{"error": ...}`` on failure.
+
+    Self-describing columns (so the artifact cannot be misread without its
+    docs): ``backward_policy`` records which backward the executor compiled
+    ('stored' or 'remat'), ``bubble_sim_w_b`` the matching per-tick backward
+    weight the ``bubble_simulated`` column was computed under, and
+    ``host_serialized`` whether the mesh was CPU-simulated on a host — where
+    every "parallel" tick serializes, wall-clock measures total work plus
+    per-tick overhead, and the throughput columns must NOT be read as
+    pipeline-overlap measurements (schedule-ordering claims come from the
+    bubble/cost-model columns; docs/results.md §2)."""
     import jax
 
     from ..models.transformer import transformer_init
@@ -62,7 +73,8 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                                n_microbatches=n_microbatches,
                                n_virtual=n_virtual)
         mesh = make_mesh(n_pipe=num_devices)
-        step = make_pipeline_step(cfg, mesh, sched)
+        step = make_pipeline_step(cfg, mesh, sched,
+                                  remat_backward=remat_backward)
 
         params = transformer_init(jax.random.key(seed), cfg)
         kx, ky = jax.random.split(jax.random.key(seed + 1))
@@ -73,8 +85,19 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                                        num_iterations=num_iterations)
         cs = compile_schedule(schedule_type, num_devices, n_virtual,
                               n_microbatches)
-        # remat backward ~ 2 fwd-equivalents of grad work + 1 recompute
-        sim = simulated_bubble(cs, w_f=1.0, w_b=3.0)
+        # bubble_simulated uses the weights of the backward the executor
+        # actually compiled, mirroring make_pipeline_grad_fn's resolution:
+        # stored (w_b=2, ~2 fwd-equivalents of grad work) at D==1 by
+        # default or on explicit remat_backward=False; otherwise remat
+        # (w_b=3: +1 recompute). Split-backward schedules always
+        # rematerialize: B = recompute + dgrad ~ 2, W = recompute +
+        # wgrad ~ 2.
+        stored = not cs.split_backward and (
+            remat_backward is False
+            or (remat_backward is None and num_devices == 1))
+        w_b, w_w = (2.0, 1.0) if stored else (
+            (3.0, 1.0) if not cs.split_backward else (2.0, 2.0))
+        sim = simulated_bubble(cs, w_f=1.0, w_b=w_b, w_w=w_w)
         metrics.update({
             "throughput_per_chip": metrics["throughput"] / num_devices,
             "n_virtual": n_virtual,
@@ -82,6 +105,9 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
             "bubble_analytic": analytic_bubble_fraction(
                 schedule_type, num_devices, n_virtual, n_microbatches, cs=cs),
             "bubble_simulated": sim["bubble_fraction"],
+            "bubble_sim_w_b": w_b,
+            "backward_policy": "stored" if stored else "remat",
+            "host_serialized": jax.devices()[0].platform == "cpu",
         })
         return metrics
     except Exception as e:  # same catch-all contract as the reference worker

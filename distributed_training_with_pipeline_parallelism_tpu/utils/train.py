@@ -32,7 +32,7 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     optimizer: optax.GradientTransformation, moe=None,
                     sp_attn_impl: str = "ring",
                     tp_vocab_parallel: bool = False,
-                    fsdp: bool = False,
+                    fsdp: bool = False, remat_backward=None,
                     ) -> Callable[[Pytree, Any, jax.Array, jax.Array],
                                   Tuple[Pytree, Any, jax.Array]]:
     """Jitted ``(params, opt_state, tokens, targets) ->
@@ -42,11 +42,14 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     :func:`..parallel.pipeline.make_pipeline_grad_fn`. ``fsdp`` runs
     ZeRO-3 inside the pipeline (params placed via ``fsdp_shard_params``;
     grads come back in the same pipe x data layout, so the optax update —
-    elementwise — runs shard-local and moments are born sharded)."""
+    elementwise — runs shard-local and moments are born sharded).
+    ``remat_backward`` picks the backward's activation policy (None = auto:
+    stored where supported; True = rematerialize for minimal activation
+    memory — see :func:`..parallel.pipeline.make_pipeline_grad_fn`)."""
     grad_fn = make_pipeline_grad_fn(cfg, mesh, sched, moe=moe,
                                     sp_attn_impl=sp_attn_impl,
                                     tp_vocab_parallel=tp_vocab_parallel,
-                                    fsdp=fsdp)
+                                    fsdp=fsdp, remat_backward=remat_backward)
 
     if cfg.dropout > 0.0:
         # train-mode dropout: the step takes a per-step PRNG key
@@ -220,7 +223,8 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         resume: bool = False, skip_data_on_resume: bool = True,
         metrics_path: Optional[str] = None, moe=None,
         sp_attn_impl: str = "ring", tp_vocab_parallel: bool = False,
-        zero1: bool = False, fsdp: bool = False, dropout_seed: int = 0,
+        zero1: bool = False, fsdp: bool = False, remat_backward=None,
+        dropout_seed: int = 0,
         eval_data: Optional[Callable[[], Iterator]] = None,
         eval_every: int = 0, eval_batches: int = 8,
         profile_dir: Optional[str] = None,
@@ -272,7 +276,7 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
     step_fn = make_train_step(cfg, mesh, sched, optimizer, moe=moe,
                               sp_attn_impl=sp_attn_impl,
                               tp_vocab_parallel=tp_vocab_parallel,
-                              fsdp=fsdp)
+                              fsdp=fsdp, remat_backward=remat_backward)
     if fsdp and zero1:
         raise ValueError("fsdp already shards optimizer state (ZeRO-3 "
                          "subsumes ZeRO-1) — drop --zero1")

@@ -49,6 +49,16 @@ def main():
                     help="Megatron parallel cross-entropy: vocab-shard the "
                          "head over the --tp model axis (logits never "
                          "materialize full-size)")
+    ap.add_argument("--backward", default="auto",
+                    choices=["auto", "remat", "stored"],
+                    help="pipeline backward policy. auto (default): the "
+                         "unrolled stored program at --pipe 1, the "
+                         "rematerializing backward at --pipe > 1 (the "
+                         "measured-fastest choice per config, "
+                         "docs/performance.md). remat: always recompute "
+                         "each stage forward (minimal activation memory). "
+                         "stored: never recompute (banked activations; "
+                         "not valid for ZB schedules or --fsdp)")
     ap.add_argument("--virtual", type=int, default=1)
     ap.add_argument("--microbatches", type=int, default=4)
     ap.add_argument("--grad-accum", type=int, default=1,
@@ -306,7 +316,10 @@ def main():
         checkpoint_every=(args.ckpt_every or args.steps) if args.ckpt else 0,
         resume=args.auto_resume, metrics_path=args.metrics or None, moe=moe,
         sp_attn_impl=args.sp_attn, tp_vocab_parallel=args.vocab_parallel,
-        zero1=args.zero1, fsdp=args.fsdp, dropout_seed=args.seed,
+        zero1=args.zero1, fsdp=args.fsdp,
+        remat_backward={"auto": None, "remat": True,
+                        "stored": False}[args.backward],
+        dropout_seed=args.seed,
         eval_data=eval_data, eval_every=args.eval_every,
         eval_batches=args.eval_batches,
         profile_dir=args.profile or None, grad_accum=args.grad_accum)

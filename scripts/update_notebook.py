@@ -94,12 +94,16 @@ def main():
         "simulates exactly that model on our tick orders and reproduces "
         "every published ordering (tested in "
         "`tests/test_schedules.py::test_async_model_reproduces_reference_orderings`).\n\n"
-        "This framework's executor makes two different choices — lockstep "
-        "ticks (one compiled program, `ppermute` barriers) and a "
-        "rematerializing backward (≈ 3 forward-equivalents) — so its "
-        "predicted orderings differ *by design*: mixed F/B ticks pay the "
-        "barrier (GPipe's homogeneous phases do not), quantified by "
-        "`simulated_bubble(w_b=3)`. On this one-core host a third term "
+        "This framework's executor differs in one structural choice — "
+        "lockstep ticks (one compiled program, `ppermute` barriers) — and "
+        "one per-config policy: at D>1 its default backward "
+        "rematerializes (≈ 3 forward-equivalents; the stored backward, "
+        "w_b≈2, is opt-in — docs/performance.md \"Backward policy\"). So "
+        "its predicted orderings differ *by design*: mixed F/B ticks pay "
+        "the barrier (GPipe's homogeneous phases do not), quantified by "
+        "`simulated_bubble` at the matching w_b (the cell below uses the "
+        "w_b=2 default; w_b=3 widens the same gaps). On this one-core "
+        "host a third term "
         "dominates both: all \"parallel\" devices share a single core, so "
         "wall-clock ≈ total work + per-tick dispatch overhead — schedules "
         "with more ticks (interleaved: 2× at V=2) measure slower "
@@ -118,7 +122,7 @@ def main():
         "        lock = 1 - simulated_bubble(compile_schedule(name, D, V, 4))[\"bubble_fraction\"]\n"
         "        rows.append({\"D\": D, \"schedule\": f\"{name}/V{V}\",\n"
         "                     \"async_stash (reference model)\": round(predicted_throughput(name, D, V, 4, 1.0) / gp_async, 3),\n"
-        "                     \"lockstep_remat (this executor)\": round(lock / gp_lock, 3)})\n"
+        "                     \"lockstep w_b=2 (this executor)\": round(lock / gp_lock, 3)})\n"
         "pd.DataFrame(rows).set_index([\"D\", \"schedule\"])")
 
     # rebuild: keep 0-4 (Part 1 incl. the memory-note markdown that

@@ -64,6 +64,11 @@ SMOKE_NODES = (
     "tests/test_pipeline.py::test_single_device_fast_path_matches_and_checks_batch",
     # zero-bubble family
     "tests/test_zero_bubble.py::test_executor_matches_single_device[2-4]",
+    # stored-activation backward: both policies explicit + error contracts
+    "tests/test_stored_backward.py::test_policy_matches_single_device[GPipe-2-1-4-False]",
+    "tests/test_stored_backward.py::test_policy_matches_single_device[GPipe-2-1-4-True]",
+    "tests/test_stored_backward.py::test_stored_rejects_split_backward",
+    "tests/test_stored_backward.py::test_stored_rejects_fsdp",
     # native C++ engine equivalence
     "tests/test_native_engine.py::test_native_matches_python[GPipe-2-1-4]",
     "tests/test_native_engine.py::test_native_matches_python[1F1B-4-1-4]",

@@ -195,10 +195,10 @@ def test_async_model_reproduces_reference_orderings():
     lockstep barrier), stashed activations (w_b=2) — the tick orders
     reproduce BASELINE.md's published orderings: Interleaved1F1B wins
     exactly when 2 virtual stages fit, the degenerate V=1 interleave ties
-    1F1B, and 1F1B ties GPipe (its win is memory). Under THIS executor's
-    lockstep+remat model (simulated_bubble defaults) GPipe leads instead —
-    which is what the committed sim-mesh sweep measures. Both models, one
-    set of tables."""
+    1F1B, and 1F1B ties GPipe (its win is memory). Under the LOCKSTEP
+    tick model (simulated_bubble — at any w_b >= 2, i.e. stored or remat
+    backward) GPipe leads instead, which is what the committed sim-mesh
+    sweep measures. Both models, one set of tables."""
     from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules import (
         async_makespan, predicted_throughput)
     toks = 32 * 128
@@ -211,12 +211,13 @@ def test_async_model_reproduces_reference_orderings():
         # degenerate interleave == 1F1B == GPipe in ticks
         assert tp[("Interleaved1F1B", 1)] == pytest.approx(tp[("1F1B", 1)])
         assert tp[("1F1B", 1)] == pytest.approx(tp[("GPipe", 1)])
-    # lockstep + remat (this executor), M=2D: GPipe's homogeneous phases
-    # keep the textbook bubble while mixed F/B ticks pay the barrier ->
-    # GPipe leads where the async model has it tied-or-behind. (At small
-    # M=D the V-bubble reduction still outweighs the barrier cost; the
-    # sim-mesh wall-clock flip there comes from per-tick dispatch overhead
-    # — 2x ticks at V=2 — quantified in docs/results.md.)
+    # lockstep (w_b=2 default; the inequality also holds at the D>1
+    # remat executor's w_b=3), M=2D: GPipe's homogeneous phases keep the
+    # textbook bubble while mixed F/B ticks pay the barrier -> GPipe
+    # leads where the async model has it tied-or-behind. (At small M=D
+    # the V-bubble reduction still outweighs the barrier cost; the
+    # sim-mesh wall-clock flip there comes from per-tick dispatch
+    # overhead — 2x ticks at V=2 — quantified in docs/results.md.)
     gp = simulated_bubble(compile_schedule("GPipe", 4, 1, 8))
     il = simulated_bubble(compile_schedule("Interleaved1F1B", 4, 2, 8))
     assert gp["bubble_fraction"] < il["bubble_fraction"]
