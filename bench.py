@@ -17,7 +17,8 @@ and the metric label states which ran. Also timed, under "extra":
 
 1. ``fused_ceiling`` — the same config on the degenerate 1-chip fast path
    (one fused full-batch step, identical loss/grads, tested): the model+
-   loss compute ceiling. headline/ceiling IS the executor overhead.
+   loss compute ceiling. ceiling/headline IS the executor overhead
+   (reported as ``tick_executor_overhead``, > 1).
 2. ``tick_executor_remat`` — the cond-dispatched tick scan with
    ``remat_backward=True`` (round-2's only mode; the D>1 default).
    ``stored_backward_speedup`` (headline/remat) is reported only where

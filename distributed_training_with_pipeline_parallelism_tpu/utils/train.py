@@ -143,35 +143,24 @@ def make_eval_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                  moe=None, sp_attn_impl: str = "ring",
                  tp_vocab_parallel: bool = False, fsdp: bool = False,
                  ) -> Callable[[Pytree, jax.Array, jax.Array], jax.Array]:
-    """Jitted eval-mode loss over the mesh. Every dense training mesh
-    (data x pipe x model x seq, any n_virtual, incl. vocab-parallel CE)
-    uses the forward-only pipelined loss (no backward cost); MoE falls back
-    to the training grad function — built with the SAME parallelization
-    knobs as the train step — with the gradients discarded (still
-    eval-mode: no rng is threaded, so dropout is off)."""
-    from ..parallel.mesh import EXPERT_AXIS as _EA, PIPE_AXIS as _PA
+    """Jitted eval-mode loss over the mesh. Every training mesh (data x
+    pipe x model x seq x expert, any n_virtual, incl. vocab-parallel CE
+    and MoE stages) uses the forward-only pipelined loss — no backward,
+    no rematerialization. **MoE convention**: the eval loss is the CE
+    term only (the routing load-balance aux is a training regularizer,
+    not a model-quality quantity — perplexity comes from CE), so an MoE
+    eval loss is directly comparable across capacity/aux settings. Any
+    configuration the training step accepts evaluates here (both require
+    n_layers to divide the stage count); dropout configs evaluate in
+    eval mode (dropout off)."""
     from ..parallel.pipeline import make_pipeline_loss_fn
 
-    dense = moe is None and mesh.shape.get(_EA, 1) == 1
-    S = mesh.shape[_PA] * sched.n_virtual
-    if dense and cfg.n_layers % S == 0:
-        eval_cfg = (dataclasses.replace(cfg, dropout=0.0)
-                    if cfg.dropout else cfg)
-        return make_pipeline_loss_fn(eval_cfg, mesh, sched,
-                                     sp_attn_impl=sp_attn_impl,
-                                     tp_vocab_parallel=tp_vocab_parallel,
-                                     fsdp=fsdp)
-    grad_fn = make_pipeline_grad_fn(
-        dataclasses.replace(cfg, dropout=0.0), mesh, sched, moe=moe,
-        sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel,
-        fsdp=fsdp)
-
-    @jax.jit
-    def loss_only(params, tokens, targets):
-        loss, _ = grad_fn(params, tokens, targets)
-        return loss
-
-    return loss_only
+    eval_cfg = (dataclasses.replace(cfg, dropout=0.0)
+                if cfg.dropout else cfg)
+    return make_pipeline_loss_fn(eval_cfg, mesh, sched,
+                                 sp_attn_impl=sp_attn_impl,
+                                 tp_vocab_parallel=tp_vocab_parallel,
+                                 fsdp=fsdp, moe=moe)
 
 
 def evaluate(eval_fn, params, data: Iterator[Tuple[jax.Array, jax.Array]],
