@@ -122,31 +122,68 @@ std::vector<Order> bfs_order(int D, int V, int M) {
 
 // ZB-H1 (arXiv:2401.10241): dgrad/wgrad split backward; stage 0 has no B
 // (nothing upstream to send a cotangent to) — its W does the full
-// parameter+embedding backward. Mirrors schedules.zb_h1_order.
+// parameter+embedding backward. Orders come from the same greedy priority
+// simulation as schedules._zb_greedy_order (B > F > W so wgrad sinks into
+// bubble ticks; in-flight forward cap 2D - d, the memory price of hitting
+// the paper's 3M + D - 1 makespan with the stage-0 dgrad elided). Must stay
+// bit-identical to the Python generator.
 std::vector<Order> zb_h1_order(int D, int M) {
+  const int S = D;
+  // done[s][op][m] = completion tick, or -1
+  std::vector<std::vector<std::vector<int>>> done(
+      S, std::vector<std::vector<int>>(3, std::vector<int>(M, -1)));
+  // per (stage, op) next-microbatch pointer: within an op, readiness is
+  // monotone in m, so the minimum remaining ready m is always the pointer
+  std::vector<std::vector<int>> next_m(S, std::vector<int>(3, 0));
+  std::vector<int> n_f(D, 0), n_w(D, 0);
   std::vector<Order> orders(D);
-  for (int d = 0; d < D; ++d) {
-    int warmup = std::min(M, D - d);
-    int nf = 0, nb = 0;
-    for (; nf < warmup; ++nf) orders[d].push_back({d, OP_F, nf});
-    if (d == 0) {
-      while (nf < M) {
-        orders[d].push_back({0, OP_W, nb++});
-        orders[d].push_back({0, OP_F, nf++});
+  int remaining = 3 * S * M - M;  // no B on stage 0
+  int t = 0;
+  const int limit = 8 * remaining + 64;
+
+  auto ready = [&](int s, int op, int m, int now) {
+    if (op == OP_F) {
+      if (s == 0) return true;
+      int d = done[s - 1][OP_F][m];
+      return d >= 0 && d + 1 <= now;
+    }
+    if (done[s][OP_F][m] < 0) return false;
+    if (op == OP_W) {
+      if (s == 0) {
+        int d = done[1][OP_B][m];
+        return d >= 0 && d + 1 <= now;
       }
-      for (; nb < M; ++nb) orders[d].push_back({0, OP_W, nb});
-    } else {
-      while (nf < M) {
-        orders[d].push_back({d, OP_B, nb});
-        orders[d].push_back({d, OP_W, nb});
-        ++nb;
-        orders[d].push_back({d, OP_F, nf++});
-      }
-      for (; nb < M; ++nb) {
-        orders[d].push_back({d, OP_B, nb});
-        orders[d].push_back({d, OP_W, nb});
+      if (s == S - 1) return true;
+      return done[s][OP_B][m] >= 0;
+    }
+    // dgrad B
+    if (s == S - 1) return true;
+    int d = done[s + 1][OP_B][m];
+    return d >= 0 && d + 1 <= now;
+  };
+
+  while (remaining > 0) {
+    if (t > limit) return {};  // deadlock: caller reports failure
+    for (int d = 0; d < D; ++d) {
+      const int s = d;  // V = 1: stage == device
+      // priority: B, then F (under the in-flight cap), then W
+      const int order_ops[3] = {OP_B, OP_F, OP_W};
+      for (int op : order_ops) {
+        if (op == OP_B && s == 0) continue;
+        int m = next_m[s][op];
+        if (m >= M) continue;
+        if (op == OP_F && n_f[d] - n_w[d] >= 2 * D - d) continue;
+        if (!ready(s, op, m, t)) continue;
+        done[s][op][m] = t;
+        next_m[s][op] = m + 1;
+        orders[d].push_back({s, op, m});
+        if (op == OP_F) ++n_f[d];
+        if (op == OP_W) ++n_w[d];
+        --remaining;
+        break;
       }
     }
+    ++t;
   }
   return orders;
 }
@@ -236,6 +273,8 @@ int dtpp_compile_schedule(const char* name, int D, int V, int M,
     if (D < 2) return fail(err, errlen, "ZBH1 requires n_devices >= 2");
     if (M < D) return fail(err, errlen, "ZBH1 requires n_microbatches >= n_devices");
     orders = zb_h1_order(D, M);
+    if (orders.empty())
+      return fail(err, errlen, "ZBH1 synthesis deadlocked");
   } else {
     return fail(err, errlen, "unknown schedule: " + sname);
   }

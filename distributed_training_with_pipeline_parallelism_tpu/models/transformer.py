@@ -260,6 +260,25 @@ def body_apply(cfg: ModelConfig, layers: Dict, h: jax.Array,
     rope = _rope(cfg, h.shape[1])
     n = jax.tree.leaves(layers)[0].shape[0]
 
+    if cfg.unroll_layers:
+        # straight-line layers: no scan boundary, so XLA fuses across
+        # layers and autodiff residuals stay SSA values instead of
+        # round-tripping HBM through stacked scan outputs (the same
+        # finding as the executor's unrolled stored backward,
+        # docs/performance.md). Compile time grows with depth; measured
+        # +5-12% train-step throughput for gpt2-small on one v5e chip.
+        def one(layer_params, x, i):
+            rng_l = (None if rng is None
+                     else jax.random.fold_in(rng, layer_offset + i))
+            return layer_apply(cfg, layer_params, x, rope, tp_axis=tp_axis,
+                               tp_size=tp_size, rng=rng_l)
+
+        if cfg.remat_layers:
+            one = jax.checkpoint(one, static_argnums=(2,))
+        for i in range(n):
+            h = one(jax.tree.map(lambda x: x[i], layers), h, i)
+        return h
+
     def step(carry, xs):
         layer_params, i = xs
         rng_l = None if rng is None else jax.random.fold_in(rng, layer_offset + i)
@@ -285,10 +304,18 @@ def head_norm_apply(cfg: ModelConfig, head: Dict, h: jax.Array) -> jax.Array:
 def head_apply(cfg: ModelConfig, head: Dict, h: jax.Array,
                embed: Optional[Dict] = None) -> jax.Array:
     hn = head_norm_apply(cfg, head, h)
+    # flatten [B, S, d] -> [B*S, d] around the vocab matmul: a 2-D dot
+    # gets the default output layout, which the fused-CE kernel (and any
+    # flat consumer) reads without a relayout — the 3-D form cost a
+    # measured 2.5 ms/step full-logits copy at GPT-2 vocab (docs/profiles/)
+    lead = hn.shape[:-1]
+    hn2 = hn.reshape(-1, hn.shape[-1]) if hn.ndim > 2 else hn
     if cfg.tie_embeddings:
         assert embed is not None, "tied head needs the embedding table"
-        return hn @ embed["tok"].T
-    return linear_apply(head["out"], hn)
+        logits = hn2 @ embed["tok"].T
+    else:
+        logits = linear_apply(head["out"], hn2)
+    return logits.reshape(*lead, logits.shape[-1]) if hn.ndim > 2 else logits
 
 
 def transformer_apply(cfg: ModelConfig, params: Dict, tokens: jax.Array,

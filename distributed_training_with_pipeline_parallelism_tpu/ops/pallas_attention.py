@@ -24,6 +24,26 @@ Causal masking prunes both loops to live blocks (at/below the diagonal for
 dq, at/right of it for dk/dv), and a sliding ``window`` tightens both
 bounds, so backward compute scales the same way forward does.
 
+Two measured kernel disciplines (round 3, one v5e chip — docs/profiles/):
+
+- **MXU**: every dot keeps its inputs in the storage dtype (bf16 on the
+  ladder configs) with f32 accumulation via ``preferred_element_type`` —
+  f32 matmul inputs run the v5e MXU at a fraction of bf16 throughput.
+  Softmax statistics (m, l, lse) stay f32.
+- **VPU**: at head_dim 64 these kernels are vector-unit-bound (~256 MXU
+  FLOPs but ~10 vector ops per score element against a ~100:1 MXU:VPU
+  peak ratio), so mask arithmetic is minimized: the row-col difference
+  tile is computed once per grid instance (k-block-invariant), each edge
+  is one scalar-broadcast compare, the mask lands on the *scores* (->
+  NEG_INF) so the downstream ``exp`` underflows dead elements to exactly
+  0.0, and the dead-row guards are only paid where a fully-dead first
+  block is actually reachable (a sliding window's left edge).
+
+A full-head-per-instance [b, s, h, dh] variant (BlockSpec-sliced heads, no
+input transposes) was measured SLOWER end-to-end than this [b*h, s, dh]
+form plus explicit transposes — Mosaic's per-head strided VMEM access and
+the head-unrolled kernel body cost more than the relayout saves.
+
 On non-TPU backends the kernels run in interpreter mode so CPU CI exercises
 the same code paths.
 """
@@ -46,28 +66,38 @@ def _use_interpret() -> bool:
     return plat not in ("tpu", "axon")
 
 
-def _keep_mask(qi_base, ki_base, shape, causal: bool, true_len: int,
-               seq_len: int, window: Optional[int]):
-    """[block_q, block_k] liveness mask, or None if everything is live.
-    Single source for forward and both backward kernels: padded key columns
-    are dead, causal drops cols > rows, window drops cols <= rows - window."""
-    if not causal and true_len == seq_len:
+def _make_block_mask(qi_base, block_shape, causal: bool, true_len: int,
+                     seq_len: int, window: Optional[int]):
+    """Per-grid-instance score-mask factory (or None if nothing masks).
+    See the module docstring's VPU discipline for why it is shaped this
+    way."""
+    if not causal and true_len == seq_len and window is None:
         return None
-    rows = qi_base + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
-    cols = ki_base + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
-    keep = cols < true_len
-    if causal:
-        keep &= rows >= cols
+    rows = jax.lax.broadcasted_iota(jnp.int32, block_shape, 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, block_shape, 1)
+    rc = rows - cols  # = (abs_row - abs_col) - (qi_base - ki_base)
+
+    def mask(s, ki_base):
+        off = ki_base - qi_base
+        keep = None
+        if causal:
+            keep = rc >= off  # abs_row >= abs_col
         if window is not None:
-            keep &= rows - cols < window
-    return keep
+            w = rc < off + window  # abs_row - abs_col < window
+            keep = w if keep is None else keep & w
+        if true_len != seq_len:
+            pad = cols < true_len - ki_base  # abs_col < true_len
+            keep = pad if keep is None else keep & pad
+        return jnp.where(keep, s, NEG_INF)
+
+    return mask
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                       causal: bool, scale: float, seq_len: int,
                       true_len: int, window: Optional[int]):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, dh]
+    q = q_ref[0]  # [block_q, dh], storage dtype
     block_q = q.shape[0]
     dh = q.shape[1]
 
@@ -84,23 +114,36 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     else:
         kv_start = 0
 
+    mask = _make_block_mask(qi * block_q, (block_q, block_k), causal,
+                            true_len, seq_len, window)
+    # A fully-dead row in a block is only a correctness hazard while its
+    # running max is still NEG_INF (exp(s - m) = exp(0) = 1 instead of 0).
+    # The first visited block always has a live element in every row —
+    # causal's block 0 contains column 0; padding keeps column 0 live —
+    # EXCEPT at a sliding window's left edge, where the top rows of the
+    # q block may open strictly later than kv_start. Only that case pays
+    # the dead-row guards.
+    guard_dead_rows = window is not None
+
     def body(ki, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [bq, bk]
-        keep = _keep_mask(qi * block_q, ki * block_k, s.shape, causal,
-                          true_len, seq_len, window)
-        if keep is not None:
-            s = jnp.where(keep, s, NEG_INF)
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :]
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
+        if mask is not None:
+            s = mask(s, ki * block_k)
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m, m_blk)
         p = jnp.exp(s - m_new[:, None])
-        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         alpha = jnp.exp(m - m_new)
-        alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
+        if guard_dead_rows:
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+            alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
         l_new = l * alpha + jnp.sum(p, axis=1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot(p, v)
+        acc_new = acc * alpha[:, None] + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
@@ -109,10 +152,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     m, l, acc = jax.lax.fori_loop(kv_start, n_kv_live, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    # per-row logsumexp of the (scaled, masked) scores; a fully-masked row
-    # lands near NEG_INF, which the backward's explicit keep-mask handles.
-    # lse rides as [bh, 1, s_pad] (rank-3) because Mosaic requires the last
-    # two block dims to tile (8, 128) or equal the array dims
+    # per-row logsumexp of the (scaled, masked) scores. lse rides as
+    # [bh, 1, s_pad] (rank-3) because Mosaic requires the last two block
+    # dims to tile (8, 128) or equal the array dims
     lse_ref[0, 0] = m + jnp.log(l)
 
 
@@ -163,8 +205,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          seq_len: int, true_len: int,
                          window: Optional[int]):
     qi = pl.program_id(1)
-    qs = q_ref[0].astype(jnp.float32) * scale  # [block_q, dh]
-    do = do_ref[0].astype(jnp.float32)
+    qs = q_ref[0]             # [block_q, dh], storage dtype (unscaled)
+    do = do_ref[0]
     lse = lse_ref[0, 0]       # [block_q] f32
     delta = delta_ref[0, 0]   # [block_q] f32
     block_q = qs.shape[0]
@@ -180,18 +222,26 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         kv_start = 0
 
+    mask = _make_block_mask(qi * block_q, (block_q, block_k), causal,
+                            true_len, seq_len, window)
+
     def body(ki, dq_acc):
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())))  # [bq, bk]
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :]
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            qs, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
+        if mask is not None:
+            s = mask(s, ki * block_k)
+        # dead elements: exp(NEG_INF - lse) underflows to exactly 0 (every
+        # row's lse is finite — its causal/window diagonal is always live)
         p = jnp.exp(s - lse[:, None])
-        keep = _keep_mask(qi * block_q, ki * block_k, s.shape, causal,
-                          true_len, seq_len, window)
-        if keep is not None:
-            p = jnp.where(keep, p, 0.0)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk] f32
         ds = p * (dp - delta[:, None])
-        return dq_acc + jax.lax.dot(ds, k)
+        return dq_acc + jax.lax.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
 
     dq0 = jnp.zeros((block_q, dh), jnp.float32)
     dq = jax.lax.fori_loop(kv_start, n_kv_live, body, dq0)
@@ -203,8 +253,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           scale: float, seq_len: int, true_len: int,
                           window: Optional[int]):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)  # [block_k, dh]
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]  # [block_k, dh], storage dtype
+    v = v_ref[0]
     block_k = k.shape[0]
     dh = k.shape[1]
 
@@ -222,36 +272,63 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         q_stop = n_q
 
+    mask_needed = causal or true_len != seq_len or window is not None
+    if mask_needed:
+        # this kernel's grid walks ki (fixed per instance), so the
+        # loop-invariant tile is rc_k = row_iota - abs_col; each edge is
+        # then one scalar-broadcast compare against the varying qi offset
+        shape = (block_q, block_k)
+        col_abs = (ki * block_k
+                   + jax.lax.broadcasted_iota(jnp.int32, shape, 1))
+        rc_k = jax.lax.broadcasted_iota(jnp.int32, shape, 0) - col_abs
+        pad_cols = col_abs < true_len if true_len != seq_len else None
+
     def body(qi, carry):
         dk_acc, dv_acc = carry
-        qs = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        qs = q_ref[0, pl.ds(qi * block_q, block_q), :]  # unscaled
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
         delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
-        s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())))  # [bq, bk]
+        s = jax.lax.dot_general(
+            qs, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
+        if mask_needed:
+            keep = None
+            if causal:
+                keep = rc_k >= -qi * block_q  # abs_row >= abs_col
+            if window is not None:
+                w = rc_k < window - qi * block_q
+                keep = w if keep is None else keep & w
+            if pad_cols is not None:
+                keep = pad_cols if keep is None else keep & pad_cols
+            s = jnp.where(keep, s, NEG_INF)
+        # padded q rows carry do = 0, so their (finite-garbage) p rows
+        # contribute exactly 0 to dk/dv; dead elements underflow to 0
         p = jnp.exp(s - lse[:, None])
-        keep = _keep_mask(qi * block_q, ki * block_k, s.shape, causal,
-                          true_len, seq_len, window)
-        if keep is not None:
-            p = jnp.where(keep, p, 0.0)
-        dv_new = dv_acc + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [bq, bk]
+        dv_new = dv_acc + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk] f32
         ds = p * (dp - delta[:, None])
-        # qs already carries the scale, so dk = ds^T (q * scale) needs none
-        dk_new = dk_acc + jax.lax.dot_general(ds, qs, (((0,), (0,)), ((), ())))
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds.astype(qs.dtype), qs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
     dk0 = jnp.zeros((block_k, dh), jnp.float32)
     dv0 = jnp.zeros((block_k, dh), jnp.float32)
     dk, dv = jax.lax.fori_loop(q_start, q_stop, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    # qs was unscaled in the dk dot, so the scale applies once here
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, window):
     """Blockwise dq/dk/dv from saved (o, lse): the [s, s] matrix never
-    materializes. Inputs [bh, s, dh] unpadded; lse [bh, 1, s_pad] (padded, from
-    the forward)."""
+    materializes. Inputs [bh, s, dh] unpadded; lse [bh, 1, s_pad] (padded,
+    from the forward)."""
     bh, s, dh = q.shape
     scale = 1.0 / (dh ** 0.5)
     block_q = min(block_q, s)

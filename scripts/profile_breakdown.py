@@ -1,0 +1,178 @@
+"""Op-category time breakdown for a train step on the real chip (XProf).
+
+The profiler-driven MFU story (VERDICT r2 item 2): trace a few steps of a
+config with ``jax.profiler``, parse the device timeline out of the XPlane
+protobuf, and print a per-HLO-category accounting — time share, achieved
+FLOP/s against the chip peak, achieved HBM bytes/s — plus the top
+individual ops with source attribution. This answers "where do the
+~80% of non-MXU cycles go" with data instead of guesses; committed
+breakdowns live in docs/profiles/.
+
+Usage (real TPU):
+    python scripts/profile_breakdown.py gpt2-small   # batch 8, seq 1024
+    python scripts/profile_breakdown.py gpt2-medium  # batch 4, seq 1024
+    python scripts/profile_breakdown.py ref          # L8/H8, batch 32, seq 128
+    python scripts/profile_breakdown.py gpt2-small --json out.json
+
+The reference's only instrumentation is ``time.time()`` around the timed
+loop (SURVEY.md §5); this is the TPU-native deep end of that row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# v5e advertised peaks (dense bf16 MXU; HBM)
+PEAK_FLOPS = 394e12
+PEAK_HBM = 819e9
+
+# Containers whose duration double-counts their children on the XLA Ops line
+CONTAINER_CATEGORIES = {"while", "conditional", "call"}
+
+
+def build_step(config: str):
+    import jax
+
+    import distributed_training_with_pipeline_parallelism_tpu as dtpp
+    from distributed_training_with_pipeline_parallelism_tpu.models import (
+        transformer as tfm)
+    from distributed_training_with_pipeline_parallelism_tpu.models.gpt2 import (
+        gpt2_config)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+        make_mesh)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        make_pipeline_step)
+
+    if config == "ref":
+        cfg = dtpp.ModelConfig(dtype="bfloat16", use_fused_xent=True,
+                               max_seq_len=128)
+        batch, seq = 32, 128
+    else:
+        size = config.split("-", 1)[1]
+        cfg = gpt2_config(size, dtype="bfloat16", use_fused_xent=True,
+                          tie_embeddings=True, unroll_layers=True)
+        batch, seq = {"small": (16, 1024), "medium": (8, 1024)}[size]
+    sched = dtpp.ScheduleConfig(name="GPipe", n_microbatches=4)
+    step = make_pipeline_step(cfg, make_mesh(n_pipe=1), sched)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (batch, seq), 0,
+                                 cfg.vocab_size)
+    return step, params, tokens, targets, batch * seq
+
+
+def capture(step, params, tokens, targets, n_steps: int, log_dir: str):
+    import jax
+
+    from distributed_training_with_pipeline_parallelism_tpu.utils.metrics import (
+        force_completion)
+
+    for _ in range(3):
+        force_completion(step(params, tokens, targets))
+    with jax.profiler.trace(log_dir):
+        for _ in range(n_steps):
+            loss, _ = step(params, tokens, targets)
+        force_completion(loss)
+
+
+def parse(log_dir: str, n_steps: int) -> dict:
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    path = sorted(glob.glob(os.path.join(
+        log_dir, "plugins/profile/*/*.xplane.pb")))[-1]
+    sp = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        sp.ParseFromString(f.read())
+    plane = next(p for p in sp.planes if "TPU" in p.name)
+    sm = plane.stat_metadata
+    md = plane.event_metadata
+
+    def md_stats(m):
+        return {sm[s.metadata_id].name: (s.str_value or s.int64_value
+                                         or s.uint64_value)
+                for s in m.stats}
+
+    steps_line = next(l for l in plane.lines if l.name == "Steps")
+    step_s = sum(ev.duration_ps for ev in steps_line.events) / 1e12 / n_steps
+
+    ops_line = next(l for l in plane.lines if l.name == "XLA Ops")
+    cats = collections.defaultdict(lambda: [0.0, 0.0, 0.0])  # t, flops, bytes
+    tops = collections.Counter()
+    src_of = {}
+    for ev in ops_line.events:
+        m = md[ev.metadata_id]
+        st = md_stats(m)
+        cat = st.get("hlo_category", "?")
+        if cat in CONTAINER_CATEGORIES:
+            continue  # children appear as their own events
+        dur = ev.duration_ps / 1e12 / n_steps
+        cats[cat][0] += dur
+        cats[cat][1] += float(st.get("flops", 0) or 0) / n_steps
+        cats[cat][2] += float(st.get("bytes_accessed", 0) or 0) / n_steps
+        base = m.name.split(" = ")[0]
+        tops[base] += dur
+        if base not in src_of:
+            src = st.get("source", "")
+            tf_op = st.get("tf_op", "")
+            src_of[base] = (str(src).split("/")[-1] or str(tf_op))[:60]
+    busy = sum(v[0] for v in cats.values())
+    return {
+        "step_time_s": step_s,
+        "busy_s": busy,
+        "idle_frac": 1.0 - busy / step_s,
+        "categories": {k: {"time_s": v[0], "share_of_step": v[0] / step_s,
+                           "gflops_per_s": v[1] / v[0] / 1e9 if v[0] else 0.0,
+                           "gbytes_per_s": v[2] / v[0] / 1e9 if v[0] else 0.0}
+                       for k, v in sorted(cats.items(),
+                                          key=lambda kv: -kv[1][0])},
+        "top_ops": [{"op": k, "ms": v * 1e3, "source": src_of.get(k, "")}
+                    for k, v in tops.most_common(15)],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("config", choices=["ref", "gpt2-small", "gpt2-medium"])
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--json", default=None, help="also write the result here")
+    args = ap.parse_args()
+
+    step, params, tokens, targets, tokens_per_step = build_step(args.config)
+    log_dir = tempfile.mkdtemp(prefix="profile_breakdown_")
+    capture(step, params, tokens, targets, args.steps, log_dir)
+    r = parse(log_dir, args.steps)
+    r["config"] = args.config
+    r["tokens_per_sec"] = tokens_per_step / r["step_time_s"]
+
+    print(f"\n=== {args.config}: {r['step_time_s']*1e3:.1f} ms/step, "
+          f"{r['tokens_per_sec']/1e3:.1f}k tok/s, "
+          f"device idle {r['idle_frac']*100:.1f}% ===")
+    print(f"{'category':24s} {'ms/step':>8s} {'% step':>7s} "
+          f"{'TFLOP/s':>8s} {'%MXU':>6s} {'GB/s':>7s} {'%HBM':>6s}")
+    for cat, v in r["categories"].items():
+        tf = v["gflops_per_s"] / 1e3
+        print(f"{cat:24s} {v['time_s']*1e3:8.2f} "
+              f"{v['share_of_step']*100:6.1f}% {tf:8.2f} "
+              f"{tf*1e12/PEAK_FLOPS*100:5.1f}% {v['gbytes_per_s']:7.1f} "
+              f"{v['gbytes_per_s']*1e9/PEAK_HBM*100:5.1f}%")
+    print("\ntop ops:")
+    for t in r["top_ops"]:
+        print(f"  {t['ms']:7.3f} ms  {t['op'][:44]:44s} {t['source']}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(r, f, indent=1)
+        print(f"\nwrote {args.json}")
+
+
+if __name__ == "__main__":
+    main()

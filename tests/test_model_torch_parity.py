@@ -153,3 +153,50 @@ def test_gpt2_causality():
     np.testing.assert_allclose(np.asarray(out[0, :-1]), np.asarray(base[0, :-1]),
                                atol=1e-5, rtol=1e-5)
     assert not np.allclose(np.asarray(out[0, -1]), np.asarray(base[0, -1]))
+
+
+# ---------------------------------------------------------------------------
+# unroll_layers: the straight-line layer loop must match the lax.scan path
+# (bench.py's GPT-2 rungs run through it — docs/performance.md "MFU sprint")
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+@pytest.mark.parametrize("arch,extra", [
+    ("gpt2", {}),
+    ("llama", {"n_kv_heads": 2}),
+    ("gpt2", {"remat_layers": True}),
+    ("gpt2", {"dropout": 0.2}),
+])
+def test_unroll_layers_matches_scan(arch, extra):
+    """Loss and grads (and, with dropout, the exact per-layer masks) are
+    identical between unroll_layers=True and the default scan."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    import distributed_training_with_pipeline_parallelism_tpu as dtpp
+    from distributed_training_with_pipeline_parallelism_tpu.models import (
+        transformer as tfm)
+
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=16, arch=arch, **extra)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 8), 0, cfg.vocab_size)
+    rng = jax.random.key(7) if cfg.dropout else None
+
+    def loss_of(c):
+        if rng is None:
+            return jax.value_and_grad(
+                lambda p: tfm.transformer_loss(c, p, tokens, tokens))(params)
+        return jax.value_and_grad(
+            lambda p: tfm.transformer_loss(c, p, tokens, tokens,
+                                           rng=rng))(params)
+
+    l_scan, g_scan = loss_of(cfg)
+    l_unroll, g_unroll = loss_of(dataclasses.replace(cfg, unroll_layers=True))
+    assert float(jnp.abs(l_scan - l_unroll)) < 1e-6
+    errs = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                        g_scan, g_unroll)
+    assert max(jax.tree.leaves(errs)) < 1e-5

@@ -17,6 +17,9 @@ pytestmark = pytest.mark.skipif(not native.native_available(),
     ("Interleaved1F1B", 2, 2, 4), ("Interleaved1F1B", 4, 2, 8),
     ("Interleaved1F1B", 2, 4, 8), ("Interleaved1F1B", 4, 1, 4),
     ("BFS", 2, 2, 4), ("BFS", 4, 2, 8), ("BFS", 4, 3, 2),
+    # ZBH1's greedy synthesis exists in both engines; keep them bit-locked
+    ("ZBH1", 2, 1, 4), ("ZBH1", 4, 1, 8), ("ZBH1", 4, 1, 16),
+    ("ZBH1", 8, 1, 16),
 ])
 def test_native_matches_python(name, D, V, M):
     py = compile_schedule(name, D, V, M)
