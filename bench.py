@@ -52,8 +52,10 @@ from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import
 
 BASELINE_TOKS_PER_SEC = 1671.32  # GPipe L8/H8 2 procs, reference cell 25
 
-# advertised bf16 dense peak per chip; the tunnel reports v5 lite (v5e)
-_PEAK_FLOPS = {"v5 lite": 394e12, "v5e": 394e12, "v5p": 459e12,
+# advertised bf16 dense peak per chip; the tunnel reports v5 lite (v5e).
+# v5e is 197 TFLOP/s bf16 (394 is its INT8 TOPS — a 2x MFU-understating
+# trap this repo fell into until round 3)
+_PEAK_FLOPS = {"v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
                "v4": 275e12, "v6": 918e12}
 
 
@@ -62,7 +64,7 @@ def chip_peak_flops() -> float:
     for key, peak in _PEAK_FLOPS.items():
         if key in kind:
             return peak
-    return 394e12  # default to v5e
+    return 197e12  # default to v5e
 
 
 def train_flops_per_token(cfg, seq: int) -> float:

@@ -31,8 +31,8 @@ Two measured kernel disciplines (round 3, one v5e chip — docs/profiles/):
   f32 matmul inputs run the v5e MXU at a fraction of bf16 throughput.
   Softmax statistics (m, l, lse) stay f32.
 - **VPU**: at head_dim 64 these kernels are vector-unit-bound (~256 MXU
-  FLOPs but ~10 vector ops per score element against a ~100:1 MXU:VPU
-  peak ratio), so mask arithmetic is minimized: the row-col difference
+  FLOPs but ~10 vector ops per score element against a ~50:1 MXU:VPU
+  peak ratio at the corrected 197 TFLOP/s bf16 peak), so mask arithmetic is minimized: the row-col difference
   tile is computed once per grid instance (k-block-invariant), each edge
   is one scalar-broadcast compare, the mask lands on the *scores* (->
   NEG_INF) so the downstream ``exp`` underflows dead elements to exactly

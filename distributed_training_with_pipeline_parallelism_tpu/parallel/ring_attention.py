@@ -32,13 +32,26 @@ NEG_INF = -1e30
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
-                   causal: bool = False) -> jax.Array:
+                   causal: bool = False, dropout_rate: float = 0.0,
+                   dropout_rng: Optional[jax.Array] = None) -> jax.Array:
     """Exact attention with sequence sharded over ``axis_name``.
 
     q, k, v: [batch, seq_local, heads, head_dim] (per-device shards; K/V head
     count may differ from Q's for GQA — repeat before calling). Returns the
     attention output for the local query chunk, identical (up to float
     associativity) to unsharded attention over the full sequence.
+
+    ``dropout_rng`` (train mode) enables attention-probability dropout with
+    dropout-after-softmax semantics (torch parity): the kept probabilities
+    are rescaled by 1/keep while the softmax DENOMINATOR stays unmasked —
+    blockwise, ``l`` accumulates the raw ``p`` and only the value-weighted
+    accumulation uses the masked/rescaled copy, which is exactly
+    ``dropout(softmax(s)) @ V`` after the final ``o / l``. Each block's
+    mask is keyed on the (query-chunk, key-chunk) GLOBAL coordinates
+    (``fold_in(rng, my)`` then ``fold_in(·, src)``), so it is invariant to
+    which ring step processes the pair — the full [S, S] mask is a
+    deterministic function of (rng, shard layout) that an unsharded oracle
+    can reconstruct block by block (tests/test_ring_attention.py).
     """
     D = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -46,6 +59,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
     s_kv = k.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32))
     perm = [(i, (i + 1) % D) for i in range(D)]
+    use_dropout = dropout_rng is not None and dropout_rate > 0.0
+    rng_q = (jax.random.fold_in(dropout_rng, my) if use_dropout else None)
 
     qf = q.astype(jnp.float32)
 
@@ -65,8 +80,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
         alpha = jnp.exp(m - m_new)
         alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
         l_new = l * alpha + jnp.sum(p, axis=-1)
+        if use_dropout:
+            keep = jax.random.bernoulli(jax.random.fold_in(rng_q, src),
+                                        1.0 - dropout_rate, s.shape)
+            p_v = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
+        else:
+            p_v = p
         o_new = (o * alpha[..., None]
-                 + jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)))
+                 + jnp.einsum("bhqk,bkhd->bhqd", p_v,
+                              v_cur.astype(jnp.float32)))
         # rotate K/V to the next device; chunk provenance rotates with it
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
@@ -101,15 +123,11 @@ def ring_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
     within each model column.
     """
     from ..ops.collectives import tp_attention_inputs, tp_output_projection
-    if dropout_rng is not None and dropout_rate > 0.0:
-        raise NotImplementedError(
-            "attention-prob dropout is not implemented for ring attention "
-            "(probs exist only blockwise per ring step); use "
-            "sp_attn_impl='ulysses' for dropout x sequence parallelism")
     b, s, _ = q_in.shape
     q_in, kv_in = tp_attention_inputs(q_in, kv_in, tp_axis)
     q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles)
-    out = ring_attention(q, k, v, axis_name, causal=causal)
+    out = ring_attention(q, k, v, axis_name, causal=causal,
+                         dropout_rate=dropout_rate, dropout_rng=dropout_rng)
     return tp_output_projection(params["o"], out.reshape(b, s, -1), tp_axis)
 
 

@@ -51,8 +51,12 @@ def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
     FFN-inner masks are the full-sequence masks' local slices
     (``sharded_dropout_apply`` over dim 1 with ``sp_size`` shards), and
     attention-prob masks ride Ulysses' post-scatter head blocks — so an sp
-    run reproduces the unsharded masks exactly. Ring attention rejects
-    attention-prob dropout (probs exist only blockwise)."""
+    run reproduces the unsharded masks exactly. Ring attention draws its
+    attention-prob masks blockwise, keyed on (q-chunk, k-chunk) global
+    coordinates (ring-step invariant; see
+    :func:`..parallel.ring_attention.ring_attention`) — valid dropout with
+    correct after-softmax semantics, though the mask layout is a function
+    of the shard count rather than the unsharded oracle's."""
     from ..models.transformer import _ffn_out, _tp_in
     from ..ops.layers import sharded_dropout_apply
 

@@ -31,9 +31,14 @@ import tempfile
 os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-# v5e advertised peaks (dense bf16 MXU; HBM)
-PEAK_FLOPS = 394e12
+# chip peaks: bf16 MXU from bench.py's chip detector (shared so the tool
+# and the benchmark can never disagree about utilization); v5e HBM
 PEAK_HBM = 819e9
+
+
+def _peak_flops() -> float:
+    from bench import chip_peak_flops
+    return chip_peak_flops()
 
 # Containers whose duration double-counts their children on the XLA Ops line
 CONTAINER_CATEGORIES = {"while", "conditional", "call"}
@@ -154,6 +159,8 @@ def main():
     r["config"] = args.config
     r["tokens_per_sec"] = tokens_per_step / r["step_time_s"]
 
+    peak = _peak_flops()
+    r["peak_flops"] = peak
     print(f"\n=== {args.config}: {r['step_time_s']*1e3:.1f} ms/step, "
           f"{r['tokens_per_sec']/1e3:.1f}k tok/s, "
           f"device idle {r['idle_frac']*100:.1f}% ===")
@@ -163,7 +170,7 @@ def main():
         tf = v["gflops_per_s"] / 1e3
         print(f"{cat:24s} {v['time_s']*1e3:8.2f} "
               f"{v['share_of_step']*100:6.1f}% {tf:8.2f} "
-              f"{tf*1e12/PEAK_FLOPS*100:5.1f}% {v['gbytes_per_s']:7.1f} "
+              f"{tf*1e12/peak*100:5.1f}% {v['gbytes_per_s']:7.1f} "
               f"{v['gbytes_per_s']*1e9/PEAK_HBM*100:5.1f}%")
     print("\ntop ops:")
     for t in r["top_ops"]:

