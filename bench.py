@@ -166,19 +166,31 @@ def run(num_iterations: int = 20) -> dict:
     # tie_embeddings=True is the real GPT-2 124M (and keeps the MFU's 6*N
     # honest: the tied table is the head matmul); unroll_layers +
     # batch 16/8 are the measured round-3 MFU levers (docs/performance.md)
-    for size, batch, key in (("small", 16, "gpt2_small_seq1024_bs16"),
-                             ("medium", 8, "gpt2_medium_seq1024_bs8")):
-        gpt2_cfg = gpt2_config(size, dtype="bfloat16", use_fused_xent=True,
-                               tie_embeddings=True, unroll_layers=True)
-        if gpt2_cfg.n_layers % n_pipe == 0:
+    from distributed_training_with_pipeline_parallelism_tpu.models.llama import (
+        llama_config)
+    rungs = [
+        (gpt2_config("small", dtype="bfloat16", use_fused_xent=True,
+                     tie_embeddings=True, unroll_layers=True),
+         16, "gpt2_small_seq1024_bs16"),
+        (gpt2_config("medium", dtype="bfloat16", use_fused_xent=True,
+                     tie_embeddings=True, unroll_layers=True),
+         8, "gpt2_medium_seq1024_bs8"),
+        # rung 4's model family (GQA + RoPE + SwiGLU + tied 128k vocab):
+        # bs4 is the largest that fits next to its own grads on one chip
+        (llama_config("llama3.2-1b", dtype="bfloat16", use_fused_xent=True,
+                      unroll_layers=True),
+         4, "llama32_1b_seq1024_bs4"),
+    ]
+    for rung_cfg, batch, key in rungs:
+        if rung_cfg.n_layers % n_pipe == 0:
             try:
-                extra[key] = run_config(gpt2_cfg, batch, 1024,
+                extra[key] = run_config(rung_cfg, batch, 1024,
                                         num_iterations)
             except Exception as e:  # pragma: no cover - hardware-dependent
                 extra[key] = {"error": str(e)}
         else:
             extra[key] = {"skipped": f"{n_pipe} devices do not divide "
-                                     f"{gpt2_cfg.n_layers} layers"}
+                                     f"{rung_cfg.n_layers} layers"}
     backward = ("unrolled stored backward" if n_pipe == 1
                 else "rematerializing backward")
     return {
