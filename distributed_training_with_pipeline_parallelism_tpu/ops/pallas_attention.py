@@ -24,7 +24,7 @@ Causal masking prunes both loops to live blocks (at/below the diagonal for
 dq, at/right of it for dk/dv), and a sliding ``window`` tightens both
 bounds, so backward compute scales the same way forward does.
 
-Two measured kernel disciplines (round 3, one v5e chip — docs/profiles/):
+Measured kernel disciplines (rounds 3-4, one v5e chip — docs/profiles/):
 
 - **MXU**: every dot keeps its inputs in the storage dtype (bf16 on the
   ladder configs) with f32 accumulation via ``preferred_element_type`` —
@@ -32,12 +32,29 @@ Two measured kernel disciplines (round 3, one v5e chip — docs/profiles/):
   Softmax statistics (m, l, lse) stay f32.
 - **VPU**: at head_dim 64 these kernels are vector-unit-bound (~256 MXU
   FLOPs but ~10 vector ops per score element against a ~50:1 MXU:VPU
-  peak ratio at the corrected 197 TFLOP/s bf16 peak), so mask arithmetic is minimized: the row-col difference
-  tile is computed once per grid instance (k-block-invariant), each edge
-  is one scalar-broadcast compare, the mask lands on the *scores* (->
-  NEG_INF) so the downstream ``exp`` underflows dead elements to exactly
-  0.0, and the dead-row guards are only paid where a fully-dead first
-  block is actually reachable (a sliding window's left edge).
+  peak ratio at the corrected 197 TFLOP/s bf16 peak), so per-score-element
+  vector work is minimized three ways (round 4):
+  1. **exp2 domain**: the softmax scale and the ``log2(e)`` factor inside
+     every ``exp`` fold into ONE constant applied to the [block_q, head_dim]
+     q tile (``qc = q * scale*log2e``), so the per-element path is
+     ``exp2(s2 - m2)`` with no multiply — the saved lse is log2-domain
+     (internal: it only ever feeds these backward kernels).
+  2. **cond-gated masking**: the row-col difference tile is computed once
+     per grid instance (k-block-invariant) and each edge is one
+     scalar-broadcast compare, but the compare+select is *executed* only
+     on blocks that can actually mask (diagonal-crossing, padded-tail, or
+     window-edge blocks) via a scalar `lax.cond`; interior blocks skip the
+     mask entirely. Masked scores go to NEG_INF so ``exp2`` underflows
+     dead elements to exactly 0.0; dead-row guards are only paid where a
+     fully-dead first block is reachable (a sliding window's left edge).
+  3. **one-sweep backward**: dq, dk and dv come out of a single kernel
+     gridded over k blocks. The q-block loop accumulates dk/dv in
+     registers and dq into a grid-revisited f32 VMEM output block
+     (index map ignores the k-grid axis; zeroed at k==0), so the scores,
+     probabilities and dp are computed ONCE per (q, k) block pair instead
+     of twice (the round-3 form ran separate dq and dk/dv kernels, each
+     redoing s, exp and dp — 7 block matmuls and ~2x the VPU work per
+     pair vs 5 matmuls here).
 
 A full-head-per-instance [b, s, h, dh] variant (BlockSpec-sliced heads, no
 input transposes) was measured SLOWER end-to-end than this [b*h, s, dh]
@@ -59,6 +76,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+LOG2E = 1.4426950408889634
 
 
 def _use_interpret() -> bool:
@@ -93,11 +111,42 @@ def _make_block_mask(qi_base, block_shape, causal: bool, true_len: int,
     return mask
 
 
+def _maybe_mask(mask, s, qi, ki, block_q: int, block_k: int, causal: bool,
+                n_kv, true_len: int, seq_len: int, window: Optional[int]):
+    """Apply the score mask only on blocks that can actually mask.
+
+    For the plain-causal / padded-tail cases the masking blocks are exactly
+    the diagonal-crossing blocks and the last (padded) k block; everything
+    strictly below the diagonal is fully live, and a scalar `lax.cond`
+    skips its compare+select (2 VPU ops per score element) entirely. A
+    sliding window also masks at its left edge, so the window path applies
+    the mask unconditionally (window blocks are few by construction)."""
+    if mask is None:
+        return s
+    if window is not None:
+        return mask(s, ki * block_k)
+    need = None
+    if causal:
+        # block crosses the diagonal iff its newest key can exceed the
+        # oldest query row: (ki+1)*bk - 1 > qi*bq - 1
+        need = (ki + 1) * block_k > qi * block_q
+    if true_len != seq_len:
+        # any block whose tail reaches past true_len holds padded keys —
+        # with block_q > block_k (s padded to the lcm) that can be several
+        # trailing blocks, not just the last one
+        pad = (ki + 1) * block_k > true_len
+        need = pad if need is None else need | pad
+    return jax.lax.cond(need, lambda x: mask(x, ki * block_k),
+                        lambda x: x, s)
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                       causal: bool, scale: float, seq_len: int,
                       true_len: int, window: Optional[int]):
     qi = pl.program_id(1)
-    q = q_ref[0]  # [block_q, dh], storage dtype
+    # exp2-domain scores: scale*log2e folds into the [block_q, dh] q tile
+    # so the per-element softmax path has no multiplies (module docstring)
+    q = (q_ref[0].astype(jnp.float32) * (scale * LOG2E)).astype(q_ref.dtype)
     block_q = q.shape[0]
     dh = q.shape[1]
 
@@ -117,7 +166,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     mask = _make_block_mask(qi * block_q, (block_q, block_k), causal,
                             true_len, seq_len, window)
     # A fully-dead row in a block is only a correctness hazard while its
-    # running max is still NEG_INF (exp(s - m) = exp(0) = 1 instead of 0).
+    # running max is still NEG_INF (exp2(s - m) = exp2(0) = 1 instead of 0).
     # The first visited block always has a live element in every row —
     # causal's block 0 contains column 0; padding keeps column 0 live —
     # EXCEPT at a sliding window's left edge, where the top rows of the
@@ -131,13 +180,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         v = v_ref[0, pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
-        if mask is not None:
-            s = mask(s, ki * block_k)
+            preferred_element_type=jnp.float32)  # [bq, bk] f32, log2-domain
+        s = _maybe_mask(mask, s, qi, ki, block_q, block_k, causal, n_kv,
+                        true_len, seq_len, window)
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m, m_blk)
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
+        p = jnp.exp2(s - m_new[:, None])
+        alpha = jnp.exp2(m - m_new)
         if guard_dead_rows:
             p = jnp.where(s <= NEG_INF / 2, 0.0, p)
             alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
@@ -152,10 +201,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     m, l, acc = jax.lax.fori_loop(kv_start, n_kv_live, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    # per-row logsumexp of the (scaled, masked) scores. lse rides as
-    # [bh, 1, s_pad] (rank-3) because Mosaic requires the last two block
+    # per-row logsumexp of the (scaled, masked) scores, in LOG2 domain
+    # (= log2 sum_j 2^{s2_j}; only the backward kernels consume it). Rides
+    # as [bh, 1, s_pad] (rank-3) because Mosaic requires the last two block
     # dims to tile (8, 128) or equal the array dims
-    lse_ref[0, 0] = m + jnp.log(l)
+    lse_ref[0, 0] = m + jnp.log2(l)
 
 
 def _pad_to_blocks(s: int, block_q: int, block_k: int) -> int:
@@ -200,63 +250,27 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     return out[:, :s, :], lse
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_k: int, causal: bool, scale: float,
-                         seq_len: int, true_len: int,
-                         window: Optional[int]):
-    qi = pl.program_id(1)
-    qs = q_ref[0]             # [block_q, dh], storage dtype (unscaled)
-    do = do_ref[0]
-    lse = lse_ref[0, 0]       # [block_q] f32
-    delta = delta_ref[0, 0]   # [block_q] f32
-    block_q = qs.shape[0]
-    dh = qs.shape[1]
-
-    n_kv = pl.cdiv(seq_len, block_k)
-    if causal:
-        n_kv_live = jax.lax.min(n_kv, ((qi + 1) * block_q + block_k - 1) // block_k)
-    else:
-        n_kv_live = n_kv
-    if window is not None:
-        kv_start = jax.lax.max(0, (qi * block_q - (window - 1)) // block_k)
-    else:
-        kv_start = 0
-
-    mask = _make_block_mask(qi * block_q, (block_q, block_k), causal,
-                            true_len, seq_len, window)
-
-    def body(ki, dq_acc):
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :]
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            qs, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
-        if mask is not None:
-            s = mask(s, ki * block_k)
-        # dead elements: exp(NEG_INF - lse) underflows to exactly 0 (every
-        # row's lse is finite — its causal/window diagonal is always live)
-        p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, bk] f32
-        ds = p * (dp - delta[:, None])
-        return dq_acc + jax.lax.dot(
-            ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
-
-    dq0 = jnp.zeros((block_q, dh), jnp.float32)
-    dq = jax.lax.fori_loop(kv_start, n_kv_live, body, dq0)
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
-
-
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q: int, causal: bool,
-                          scale: float, seq_len: int, true_len: int,
-                          window: Optional[int]):
+def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, *, block_q: int, causal: bool,
+                      scale: float, seq_len: int, true_len: int,
+                      window: Optional[int]):
+    """One-sweep backward: grid (batch*heads, k blocks). Each instance owns
+    one k block, loops over its live q blocks, accumulates dk/dv in f32
+    carries, and accumulates dq into a grid-revisited f32 VMEM output block
+    (its index map ignores the k-grid axis, so the block stays resident
+    across the sweep; zeroed when the sweep starts). Scores, probabilities
+    and dp are computed once per (q, k) block pair — the round-3 two-kernel
+    form computed each twice."""
     ki = pl.program_id(1)
     k = k_ref[0]  # [block_k, dh], storage dtype
     v = v_ref[0]
     block_k = k.shape[0]
     dh = k.shape[1]
+    c = scale * LOG2E  # exp2-domain fold, matching the forward's lse
+
+    @pl.when(ki == 0)
+    def _zero_dq():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
 
     n_q = pl.cdiv(seq_len, block_q)
     if causal:
@@ -283,28 +297,47 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         rc_k = jax.lax.broadcasted_iota(jnp.int32, shape, 0) - col_abs
         pad_cols = col_abs < true_len if true_len != seq_len else None
 
+    def apply_mask(s, qi):
+        keep = None
+        if causal:
+            keep = rc_k >= -qi * block_q  # abs_row >= abs_col
+        if window is not None:
+            w = rc_k < window - qi * block_q
+            keep = w if keep is None else keep & w
+        if pad_cols is not None:
+            keep = pad_cols if keep is None else keep & pad_cols
+        return jnp.where(keep, s, NEG_INF)
+
     def body(qi, carry):
         dk_acc, dv_acc = carry
         qs = q_ref[0, pl.ds(qi * block_q, block_q), :]  # unscaled
+        qc = (qs.astype(jnp.float32) * c).astype(qs.dtype)
         do = do_ref[0, pl.ds(qi * block_q, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]   # log2-domain
         delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
         s = jax.lax.dot_general(
-            qs, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
+            qc, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk] f32, log2-domain
         if mask_needed:
-            keep = None
-            if causal:
-                keep = rc_k >= -qi * block_q  # abs_row >= abs_col
-            if window is not None:
-                w = rc_k < window - qi * block_q
-                keep = w if keep is None else keep & w
-            if pad_cols is not None:
-                keep = pad_cols if keep is None else keep & pad_cols
-            s = jnp.where(keep, s, NEG_INF)
+            if window is None:
+                # cond-gate: only diagonal-crossing / padded-tail blocks
+                # mask (see _maybe_mask; window blocks mask unconditionally)
+                need = None
+                if causal:
+                    need = qi * block_q < (ki + 1) * block_k
+                if pad_cols is not None:
+                    # see _maybe_mask: every trailing block reaching past
+                    # true_len holds padded keys, not only the last one
+                    pad = (ki + 1) * block_k > true_len
+                    need = pad if need is None else need | pad
+                s = jax.lax.cond(need, lambda x: apply_mask(x, qi),
+                                 lambda x: x, s)
+            else:
+                s = apply_mask(s, qi)
         # padded q rows carry do = 0, so their (finite-garbage) p rows
-        # contribute exactly 0 to dk/dv; dead elements underflow to 0
-        p = jnp.exp(s - lse[:, None])
+        # contribute exactly 0 everywhere; dead elements underflow to 0
+        # (every live row's lse is finite — its diagonal is always live)
+        p = jnp.exp2(s - lse[:, None])
         dv_new = dv_acc + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -312,9 +345,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk] f32
         ds = p * (dp - delta[:, None])
+        dsb = ds.astype(qs.dtype)
         dk_new = dk_acc + jax.lax.dot_general(
-            ds.astype(qs.dtype), qs, (((0,), (0,)), ((), ())),
+            dsb, qs, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        # dq rides unscaled f32; the caller applies `scale` (fused by XLA
+        # into the cast/transpose that follows the kernel)
+        dq_ref[0, pl.ds(qi * block_q, block_q), :] += jax.lax.dot(
+            dsb, k, preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
     dk0 = jnp.zeros((block_k, dh), jnp.float32)
@@ -328,7 +366,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, window):
     """Blockwise dq/dk/dv from saved (o, lse): the [s, s] matrix never
     materializes. Inputs [bh, s, dh] unpadded; lse [bh, 1, s_pad] (padded,
-    from the forward)."""
+    log2-domain, from the forward). One fused kernel produces all three
+    grads (see _flash_bwd_kernel)."""
     bh, s, dh = q.shape
     scale = 1.0 / (dh ** 0.5)
     block_q = min(block_q, s)
@@ -341,43 +380,33 @@ def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, window):
         pad3 = ((0, 0), (0, s_pad - s), (0, 0))
         q, k, v, g = (jnp.pad(x, pad3) for x in (q, k, v, g))
         delta = jnp.pad(delta, ((0, 0), (0, 0), (0, s_pad - s)))
-    common = dict(causal=causal, scale=scale, seq_len=s_pad, true_len=s,
-                  window=window)
-    qkv_spec_blocked_q = [
-        pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),   # q
-        pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),     # k
-        pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),     # v
-        pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),   # do
-        pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),    # lse
-        pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),    # delta
-    ]
-    dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, **common),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        grid=(bh, s_pad // block_q),
-        in_specs=qkv_spec_blocked_q,
-        out_specs=pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),
-        interpret=_use_interpret(),
-    )(q, k, v, g, lse, delta)
-
-    qkv_spec_blocked_k = [
-        pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),     # q
-        pl.BlockSpec((1, block_k, dh), lambda i, j: (i, j, 0)),   # k
-        pl.BlockSpec((1, block_k, dh), lambda i, j: (i, j, 0)),   # v
-        pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),     # do
-        pl.BlockSpec((1, 1, s_pad), lambda i, j: (i, 0, 0)),      # lse
-        pl.BlockSpec((1, 1, s_pad), lambda i, j: (i, 0, 0)),      # delta
-    ]
-    dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, **common),
-        out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_kernel, block_q=block_q, causal=causal,
+                          scale=scale, seq_len=s_pad, true_len=s,
+                          window=window),
+        out_shape=(jax.ShapeDtypeStruct(q.shape, jnp.float32),  # dq, f32
+                   jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)),
         grid=(bh, s_pad // block_k),
-        in_specs=qkv_spec_blocked_k,
-        out_specs=(pl.BlockSpec((1, block_k, dh), lambda i, j: (i, j, 0)),
-                   pl.BlockSpec((1, block_k, dh), lambda i, j: (i, j, 0))),
+        in_specs=[
+            pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),     # q
+            pl.BlockSpec((1, block_k, dh), lambda i, j: (i, j, 0)),   # k
+            pl.BlockSpec((1, block_k, dh), lambda i, j: (i, j, 0)),   # v
+            pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),     # do
+            pl.BlockSpec((1, 1, s_pad), lambda i, j: (i, 0, 0)),      # lse
+            pl.BlockSpec((1, 1, s_pad), lambda i, j: (i, 0, 0)),      # delta
+        ],
+        out_specs=(
+            # dq: revisited across the k-grid axis (accumulator)
+            pl.BlockSpec((1, s_pad, dh), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda i, j: (i, j, 0)),
+        ),
         interpret=_use_interpret(),
     )(q, k, v, g, lse, delta)
+    # the deferred `scale` fold (see kernel docstring); XLA fuses it into
+    # the cast + transpose that follow
+    dq = (dq * scale).astype(q.dtype)
     return dq[:, :s, :], dk[:, :s, :], dv[:, :s, :]
 
 

@@ -172,3 +172,29 @@ def test_flash_ragged_seq_lengths(s, block, window):
                           window=window)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_unequal_blocks_multi_padded_kblocks(causal):
+    """Regression (round-4 review): with block_q > block_k the sequence
+    pads to lcm(block_q, block_k), so SEVERAL trailing k blocks hold
+    padded keys — the cond-gated pad mask must catch all of them, not
+    just the last (ki == n_kv-1). Forward and grads vs dense."""
+    b, s, h, dh = 2, 37, 2, 8     # s_pad = lcm(32, 8) = 64 -> 3 padded k blocks
+    kq, kk, kv, kg = jax.random.split(jax.random.key(9), 4)
+    q = jax.random.normal(kq, (b, s, h, dh))
+    k = jax.random.normal(kk, (b, s, h, dh))
+    v = jax.random.normal(kv, (b, s, h, dh))
+    g = jax.random.normal(kg, (b, s, h, dh))
+    got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=8)
+    want = _full(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    gf = jax.grad(lambda q, k, v: jnp.vdot(
+        flash_attention(q, k, v, causal=causal, block_q=32, block_k=8), g),
+        argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: jnp.vdot(_full(q, k, v, causal), g),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-5, rtol=2e-5)
