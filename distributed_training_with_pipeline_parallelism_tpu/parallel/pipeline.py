@@ -42,6 +42,7 @@ from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.transformer import (body_apply, compute_cast, embed_apply,
@@ -72,20 +73,68 @@ def _shard_map(fn, mesh, in_specs, out_specs):
 Pytree = Any
 
 
-def _fsdp_sharded_mask(cfg: ModelConfig, n_data: int) -> Pytree:
-    """Which layer leaves shard over 'data' under pp x fsdp: MATRICES whose
-    first weight dim divides n_data (q/k/v/o/ffn weights — template leaves
-    are layer-stacked ``[L, w0, ...]``, so a matrix has ndim >= 3). Norm
-    scales and biases ([L, dim], ndim 2) stay replicated: they are O(dim),
-    noise next to the matrices, and sharding them would add latency-bound
-    collectives per tick for nothing. The SINGLE source of the layout —
+def _fsdp_shard_dims(cfg: ModelConfig, n_data: int, T: int = 1) -> Pytree:
+    """Per-leaf 'data'-shard dim under pp x fsdp (ZeRO-3): for MATRICES
+    (q/k/v/o/ffn weights — template leaves are layer-stacked ``[L, w0,
+    ...]``, so a matrix has ndim >= 3) the first weight dim that (a) is not
+    Megatron-sharded over 'model' when ``T > 1`` — the round-4 pp x fsdp x
+    tp composition puts 'data' and 'model' on DIFFERENT dims of the same
+    leaf — and (b) divides ``n_data``. ``-1`` = replicated over 'data'
+    (norm scales, biases: they are O(dim), noise next to the matrices, and
+    sharding them would add latency-bound collectives per tick for
+    nothing). Dim indices are the layer template's ([L, w0, w1, ...]);
+    the executor's stacked [D, V, lps, w0, ...] layout offsets them by +2,
+    while the in-shard_map gathers/scatters (chunk-selected [lps, w0,
+    ...]) use them as-is. The SINGLE source of the layout —
     ``make_pipeline_grad_fn``'s in/out specs and ``fsdp_shard_params``'s
     placement must agree or jit silently reshards every leaf every step."""
     from ..models.transformer import transformer_init
     template = jax.eval_shape(
         lambda: transformer_init(jax.random.key(0), cfg))["layers"]
-    return jax.tree.map(
-        lambda l: l.ndim >= 3 and l.shape[1] % n_data == 0, template)
+    if T > 1:
+        from .tensor_parallel import _layer_specs
+        tp_specs = _layer_specs(cfg)
+    else:
+        tp_specs = jax.tree.map(lambda _: P(), template)
+
+    def dim_for(leaf, spec):
+        if leaf.ndim < 3:
+            return -1
+        entries = tuple(spec) + (None,) * (leaf.ndim - len(tuple(spec)))
+        for dim in range(1, leaf.ndim):
+            if entries[dim] is None and leaf.shape[dim] % n_data == 0:
+                return dim
+        return -1
+
+    return jax.tree.map(dim_for, template, tp_specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def _dense_layer_specs(cfg: ModelConfig, T: int, fsdp_dims) -> Pytree:
+    """Stacked-layout ([D, V, lps, w0, ...]) PartitionSpecs for dense
+    stages: the Megatron 'model' placement (``T > 1``) merged with the
+    per-leaf fsdp 'data' dims (stacked offset +2). Each leaf carries at
+    most one axis per dim — :func:`_fsdp_shard_dims` picked 'data' dims
+    disjoint from the 'model' ones."""
+    if T > 1:
+        from .tensor_parallel import pipeline_layer_specs
+        base = pipeline_layer_specs(cfg, PIPE_AXIS)
+    else:
+        base = jax.tree.map(lambda _: P(PIPE_AXIS), fsdp_dims)
+    if fsdp_dims is None:
+        return base
+
+    def merge(spec, dm):
+        if dm < 0:
+            return spec
+        e = list(tuple(spec))
+        e += [None] * (dm + 3 - len(e))
+        assert e[dm + 2] is None, (spec, dm)
+        e[dm + 2] = DATA_AXIS
+        return P(*e)
+
+    return jax.tree.map(merge, base, fsdp_dims,
+                        is_leaf=lambda x: isinstance(x, P))
 
 
 def _compile(name: str, D: int, V: int, M: int) -> CompiledSchedule:
@@ -280,10 +329,6 @@ def _check_moe_mesh(cfg: ModelConfig, moe, T: int, n_seq: int,
                     n_ep: int) -> None:
     """The MoE mesh-composition contract, shared by the training executor
     and the forward-only eval program (raise identically on both)."""
-    if cfg.tie_embeddings:
-        raise NotImplementedError(
-            "tie_embeddings composes with dense stages (MoE keeps its own "
-            "head)")
     if n_seq > 1:
         raise NotImplementedError(
             "MoE pipeline composes with data/pipe/expert/model axes; "
@@ -300,12 +345,33 @@ def _check_moe_mesh(cfg: ModelConfig, moe, T: int, n_seq: int,
             f"divisible by the model-axis size {T}")
 
 
+# Auto-unroll threshold for the tick executor: tables at or below this many
+# tick rows compile as straight-line code (each row's units traced once
+# more), above it the lax.scan form keeps compile time bounded. ~32 rows
+# covers e.g. GPipe/1F1B to D=8 x M=12 and Interleaved V=2 to D=4 x M=8.
+_UNROLL_TICKS_LIMIT = 32
+
+
+def _concrete_know(col_vals):
+    """Concrete (unrolled-tick) knowledge of a unit predicate across the
+    pipe axis: True = every device takes the unit, False = none does,
+    None = mixed, or no concrete row (the scan path)."""
+    if col_vals is None:
+        return None
+    if (col_vals >= 0).all():
+        return True
+    if (col_vals < 0).all():
+        return False
+    return None
+
+
 def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                           force_tick_executor: bool = False, moe=None,
                           sp_attn_impl: str = "ring",
                           tp_vocab_parallel: bool = False,
                           fsdp: bool = False,
                           remat_backward=None,
+                          unroll_ticks=None,
                           ) -> Callable[[Pytree, jax.Array, jax.Array],
                                         Tuple[jax.Array, Pytree]]:
     """Build an (unjitted) ``(params, tokens, targets) -> (loss, grads)``
@@ -354,15 +420,34 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
       parameter grads by design; ``fsdp=True``, where residuals would pin
       the just-in-time-gathered full weights).
 
+    ``unroll_ticks`` (round 4, VERDICT r3 item 2 — the SPMD analog of
+    upstream's per-rank lowered-IR execution, ``schedules.py:2279-2337``):
+    emit the tick program as straight-line code instead of a ``lax.scan``
+    over table rows. Each tick's per-device COLUMN VALUES stay dynamic
+    (``table[t][axis_index]`` scalar reads — one program for all devices),
+    but the tick LOOP is a Python loop over the concrete table, so the
+    scan boundary — which forces every cross-tick value through HBM and
+    blocks forward/backward fusion — disappears, and per-tick structure
+    specializes against the concrete rows: units that every device takes
+    lose their ``lax.cond``, all-idle units and never-banked ring
+    transfers are elided entirely (warmup ticks carry no backward ring
+    hop, cooldown no forward one). ``None`` (auto): unroll when the table
+    has at most ``_UNROLL_TICKS_LIMIT`` rows. Composes with every backward
+    policy and mesh axis — it changes the loop form only.
+
     ``fsdp=True`` (pp x fsdp, ZeRO-3 within the pipeline): per-stage layer
-    weights live sharded over the 'data' axis (first weight dim split
-    n_data ways — use :func:`fsdp_shard_params` to place them), each tick's
-    active virtual chunk is all-gathered just in time inside the compute
-    unit, and layer gradients are reduce-scattered per backward tick, so
-    the grad accumulator carry is sharded too. Per-device layer-param
-    residency drops from full-stage to 1/n_data of it (+ one transient
-    gathered chunk); grads/optimizer state inherit the sharding through
-    the returned pytree. Dense stages only (no model/seq/expert axes).
+    weights live sharded over the 'data' axis (per-leaf weight dim from
+    :func:`_fsdp_shard_dims` — use :func:`fsdp_shard_params` to place
+    them), each tick's active virtual chunk is all-gathered just in time
+    inside the compute unit, and layer gradients are reduce-scattered per
+    backward tick, so the grad accumulator carry is sharded too.
+    Per-device layer-param residency drops from full-stage to 1/n_data of
+    it (+ one transient gathered chunk); grads/optimizer state inherit the
+    sharding through the returned pytree. Composes with Megatron TP
+    (round 4): on a 3-D ``data x pipe x model`` mesh each matrix leaf is
+    'model'-split on its Megatron dim and 'data'-split on a DIFFERENT
+    dim, so residency is ~1/(D * T * n_data). Seq/expert axes still
+    excluded.
     """
     D = mesh.shape[PIPE_AXIS]
     n_data = mesh.shape.get(DATA_AXIS, 1)
@@ -395,16 +480,13 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         if n_data <= 1:
             raise ValueError("fsdp=True needs a 'data' mesh axis to shard "
                              "parameters over")
-        if T > 1 or n_seq > 1 or moe is not None:
+        if n_seq > 1 or moe is not None:
             raise NotImplementedError(
-                "pp x fsdp composes with dense data x pipe meshes; model/"
-                "seq/expert axes would need a second sharding dim per leaf")
-    fsdp_sharded = _fsdp_sharded_mask(cfg, n_data) if fsdp else None
+                "pp x fsdp composes with dense data x pipe (x model) "
+                "meshes; seq/expert axes would need a third sharding dim "
+                "per leaf")
+    fsdp_dims = _fsdp_shard_dims(cfg, n_data, T) if fsdp else None
     use_dropout = cfg.dropout > 0.0
-    if use_dropout and moe is not None:
-        raise NotImplementedError(
-            "dropout is not plumbed through MoE stage bodies (the GShard "
-            "blocks would need mask streams per expert slot)")
     # pad masking composes with every supported mesh, including MoE/expert
     # stages: the CE is globally valid-count normalized while the routing
     # aux loss stays token-uniform (routing happens for pad positions too —
@@ -472,6 +554,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     if use_phase:
         return _make_phase_stored_grad_fn(cfg, mesh, sched, sp_attn_impl,
                                           tp_vocab_parallel)
+    if unroll_ticks is None:
+        unroll_ticks = cs.table.shape[0] <= _UNROLL_TICKS_LIMIT
     table = jnp.asarray(cs.table)  # [T, D, N_COLS]
     dtype = jnp.dtype(cfg.dtype)
     fwd_perm = [(i, (i + 1) % D) for i in range(D)]
@@ -506,6 +590,13 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             if n_data > 1:  # decorrelate masks across data replicas
                 base_rng = jax.random.fold_in(
                     base_rng, jax.lax.axis_index(DATA_AXIS))
+            if n_ep > 1:
+                # 'expert' doubles as a batch axis (batch_spec shards the
+                # batch over data x expert): each expert shard holds
+                # DIFFERENT tokens, so its masks must draw a distinct
+                # stream too
+                base_rng = jax.random.fold_in(
+                    base_rng, jax.lax.axis_index(EXPERT_AXIS))
         else:
             base_rng = None
 
@@ -536,16 +627,26 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             layer_p = compute_cast(cfg, layer_p)  # bf16 compute, fp32 masters
             if moe is not None:
                 from ..models.moe import moe_layer_apply
+                rng_mb = mb_rng(mm)
+                offset = stage_of(vv) * lps
 
-                def mstep(carry, lp):
+                def mstep(carry, xs):
+                    lp, i = xs
                     h, aux = carry
+                    # per-layer dropout stream keyed on the GLOBAL layer
+                    # index, matching the dense body's convention — masks
+                    # are (D, V)-partition invariant
+                    rng_l = (None if rng_mb is None
+                             else jax.random.fold_in(rng_mb, offset + i))
                     h, a = moe_layer_apply(cfg, moe, lp, h, ep_axis,
-                                           tp_axis=tp_axis, tp_size=T)
+                                           tp_axis=tp_axis, tp_size=T,
+                                           rng=rng_l)
                     return (h, aux + a), None
 
                 if cfg.remat_layers:
                     mstep = jax.checkpoint(mstep)
-                (y, aux), _ = jax.lax.scan(mstep, (x, zero), layer_p)
+                (y, aux), _ = jax.lax.scan(mstep, (x, zero),
+                                           (layer_p, jnp.arange(lps)))
                 return y, aux
             if sp_axis is None:
                 return (body_apply(cfg, layer_p, x, tp_axis=tp_axis,
@@ -580,14 +681,17 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         def stage_params(vv):
             """This tick's active chunk parameters. Under fsdp the sharded
             leaves all-gather over 'data' just in time — only ONE chunk's
-            full weights are ever resident, and only for the tick."""
+            full weights are ever resident, and only for the tick. The
+            gather dim is per-leaf (``_fsdp_shard_dims``): with TP, 'data'
+            rides a different dim than the leaf's 'model' shard."""
             p = select_v(layers_local, vv)
             if not fsdp:
                 return p
             return jax.tree.map(
-                lambda x, sh: jax.lax.all_gather(x, DATA_AXIS, axis=1,
-                                                 tiled=True) if sh else x,
-                p, fsdp_sharded)
+                lambda x, dm: jax.lax.all_gather(x, DATA_AXIS, axis=dm,
+                                                 tiled=True) if dm >= 0
+                else x,
+                p, fsdp_dims)
 
         def scatter_chunk_grads(gp):
             """ZeRO-2 half of fsdp: reduce-scatter this tick's full chunk
@@ -597,10 +701,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             if not fsdp:
                 return gp
             return jax.tree.map(
-                lambda g, sh: jax.lax.psum_scatter(
-                    g, DATA_AXIS, scatter_dimension=1, tiled=True)
-                if sh else g,
-                gp, fsdp_sharded)
+                lambda g, dm: jax.lax.psum_scatter(
+                    g, DATA_AXIS, scatter_dimension=dm, tiled=True)
+                if dm >= 0 else g,
+                gp, fsdp_dims)
 
         masked_store = _masked_store
 
@@ -681,46 +785,71 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         else:
             res_mask = stored_struct = res_struct = ()
 
-        def run_unit(pred, unit, noop, operand):
+        def run_unit(pred, unit, noop, operand, know=None):
             """Execute one schedule unit. Default: a lax.cond (idle devices
             take the cheap branch; psum/all_to_all inside are grouped, so a
             group that skips together is fine). Ring-attention stages: run
             the unit unconditionally and where-mask its outputs against the
             noop's — ppermute (flat-pair collective-permute) requires full
             participation, so every seq peer must execute the unit's ring
-            collectives every tick (see docs/parallelism.md)."""
+            collectives every tick (see docs/parallelism.md). ``know``
+            (unrolled ticks): the concrete device-uniform predicate — the
+            cond/mask disappears. Elision is uniform across seq/model/data
+            peers because the table row is shared along those axes."""
+            if know is True:
+                return unit(operand)
+            if know is False:
+                return noop(operand)
             if not uniform_units:
                 return jax.lax.cond(pred, unit, noop, operand)
             return jax.tree.map(lambda n, o: jnp.where(pred, n, o),
                                 unit(operand), noop(operand))
 
-        def transfers(fwd_send, bwd_send):
+        def transfers(fwd_send, bwd_send, next_concrete=None):
             """End-of-tick ring hops. Classic wrap placement: activations
             ride +1, cotangents -1. With reverse routes (vshape), the same
             send values ALSO ride the opposite rings — each consumer banks
             only from the channel its table entry names, so the extra
-            copies are dead unless routed."""
-            fr = jax.lax.ppermute(fwd_send, PIPE_AXIS, fwd_perm)
-            br = jax.lax.ppermute(bwd_send, PIPE_AXIS, bwd_perm)
+            copies are dead unless routed. Unrolled ticks pass the NEXT
+            tick's concrete row block: a channel no device banks next tick
+            is dead, so its ppermute is elided (zeros flow instead) — the
+            last tick and e.g. GPipe's whole warmup lose their grad-ring
+            hops this way."""
+            def hop(send, perm, bank_col):
+                if next_concrete is not None and (
+                        next_concrete[:, bank_col] < 0).all():
+                    return jnp.zeros(mb_shape, dtype)
+                return jax.lax.ppermute(send, PIPE_AXIS, perm)
+
+            fr = hop(fwd_send, fwd_perm, COL_STORE_F_SLOT)
+            br = hop(bwd_send, bwd_perm, COL_STORE_B_SLOT)
             if not reverse_routes:
                 return (fr, br)
             return (fr, br,
-                    jax.lax.ppermute(fwd_send, PIPE_AXIS, bwd_perm),
-                    jax.lax.ppermute(bwd_send, PIPE_AXIS, fwd_perm))
+                    hop(fwd_send, bwd_perm, COL_STORE_F_NEG_SLOT),
+                    hop(bwd_send, fwd_perm, COL_STORE_B_POS_SLOT))
 
-        def tick(carry, row_all):
+        def tick(carry, row_all, concrete=None, next_concrete=None):
             (act_buf, grad_buf, res_bufs, recvs,
              g_layers, g_embed, g_head, loss_acc) = carry
             row = row_all[d]
 
+            def ccol(col):
+                return None if concrete is None else concrete[:, col]
+
+            def store(buf, val, col):
+                # unrolled: a row block that banks nowhere skips the
+                # masked dynamic-update-slice entirely
+                if concrete is not None and (concrete[:, col] < 0).all():
+                    return buf
+                return masked_store(buf, val, row[col])
+
             # 1. bank arrivals from last tick's ppermute channels
-            act_buf = masked_store(act_buf, recvs[0], row[COL_STORE_F_SLOT])
-            grad_buf = masked_store(grad_buf, recvs[1], row[COL_STORE_B_SLOT])
+            act_buf = store(act_buf, recvs[0], COL_STORE_F_SLOT)
+            grad_buf = store(grad_buf, recvs[1], COL_STORE_B_SLOT)
             if reverse_routes:
-                act_buf = masked_store(act_buf, recvs[2],
-                                       row[COL_STORE_F_NEG_SLOT])
-                grad_buf = masked_store(grad_buf, recvs[3],
-                                        row[COL_STORE_B_POS_SLOT])
+                act_buf = store(act_buf, recvs[2], COL_STORE_F_NEG_SLOT)
+                grad_buf = store(grad_buf, recvs[3], COL_STORE_B_POS_SLOT)
 
             # 2. forward unit
             fv, fm, fslot = row[COL_FWD_V], row[COL_FWD_M], row[COL_FWD_SLOT]
@@ -764,7 +893,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
                 (act_buf, res_bufs, loss_acc), fwd_send = run_unit(
                     fm >= 0, fwd_unit, fwd_noop,
-                    (act_buf, res_bufs, loss_acc))
+                    (act_buf, res_bufs, loss_acc),
+                    know=_concrete_know(ccol(COL_FWD_M)))
             else:
                 def fwd_unit(act_buf):
                     vv, mm = jnp.maximum(fv, 0), jnp.maximum(fm, 0)
@@ -781,12 +911,12 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     return act_buf, jnp.zeros(mb_shape, dtype)
 
                 act_buf, fwd_send = run_unit(fm >= 0, fwd_unit, fwd_noop,
-                                             act_buf)
+                                             act_buf,
+                                             know=_concrete_know(ccol(COL_FWD_M)))
             if reverse_routes:
                 # same-device hop (vshape's V turning point): the output IS
                 # the next chunk's input — bank it locally, no ring transit
-                act_buf = masked_store(act_buf, fwd_send,
-                                       row[COL_FWD_LOCAL_SLOT])
+                act_buf = store(act_buf, fwd_send, COL_FWD_LOCAL_SLOT)
 
             # 3. backward unit (rematerializing)
             bv, bm = row[COL_BWD_V], row[COL_BWD_M]
@@ -813,10 +943,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     return loss_acc, jnp.zeros(mb_shape, dtype)
 
                 loss_acc, bwd_send = run_unit(bm >= 0, dgrad_unit,
-                                              dgrad_noop, loss_acc)
+                                              dgrad_noop, loss_acc,
+                                              know=_concrete_know(ccol(COL_BWD_M)))
                 if reverse_routes:
-                    grad_buf = masked_store(grad_buf, bwd_send,
-                                            row[COL_BWD_LOCAL_SLOT])
+                    grad_buf = store(grad_buf, bwd_send, COL_BWD_LOCAL_SLOT)
 
                 wv, wm = row[COL_W_V], row[COL_W_M]
 
@@ -856,10 +986,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
                 (g_layers, g_embed, g_head) = run_unit(
                     wm >= 0, wgrad_unit, lambda op: op,
-                    (g_layers, g_embed, g_head))
+                    (g_layers, g_embed, g_head),
+                    know=_concrete_know(ccol(COL_W_M)))
 
                 return (act_buf, grad_buf, res_bufs,
-                        transfers(fwd_send, bwd_send),
+                        transfers(fwd_send, bwd_send, next_concrete),
                         g_layers, g_embed, g_head, loss_acc), None
 
             def bwd_unit_stored(operand):
@@ -971,15 +1102,15 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
             (g_layers, g_embed, g_head, loss_acc), bwd_send = run_unit(
                 bm >= 0, bwd_unit_stored if use_stored else bwd_unit_remat,
-                bwd_noop, (g_layers, g_embed, g_head, loss_acc))
+                bwd_noop, (g_layers, g_embed, g_head, loss_acc),
+                know=_concrete_know(ccol(COL_BWD_M)))
             if reverse_routes:
-                grad_buf = masked_store(grad_buf, bwd_send,
-                                        row[COL_BWD_LOCAL_SLOT])
+                grad_buf = store(grad_buf, bwd_send, COL_BWD_LOCAL_SLOT)
 
             # 4. ring transfer: activations +1, gradients -1 (ICI hops);
             # vshape placements add the two reverse channels
             return (act_buf, grad_buf, res_bufs,
-                    transfers(fwd_send, bwd_send),
+                    transfers(fwd_send, bwd_send, next_concrete),
                     g_layers, g_embed, g_head, loss_acc), None
 
         n_chan = 4 if reverse_routes else 2
@@ -994,7 +1125,21 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             jax.tree.map(jnp.zeros_like, head),
             jnp.zeros((), jnp.float32),
         )
-        carry, _ = jax.lax.scan(tick, carry0, table)
+        if unroll_ticks:
+            # straight-line tick program: the Python loop IS the schedule,
+            # each tick specialized against its concrete table row block
+            # (cond/ppermute/store elision — see the tick helpers above)
+            carry = carry0
+            n_rows = cs.table.shape[0]
+            # after the final tick nothing banks: an all-dead pseudo-row
+            # elides the last hops (None means "no knowledge" — scan path)
+            end_row = np.full_like(cs.table[0], -1)
+            for t in range(n_rows):
+                nxt = cs.table[t + 1] if t + 1 < n_rows else end_row
+                carry, _ = tick(carry, table[t], concrete=cs.table[t],
+                                next_concrete=nxt)
+        else:
+            carry, _ = jax.lax.scan(tick, carry0, table)
         (_, _, _, _, g_layers, g_embed, g_head, loss_acc) = carry
 
         # Reductions: loss lives on the last stage only; embed/head grads on
@@ -1018,9 +1163,9 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 # the per-tick psum_scatter — only the scale remains; a
                 # second psum here would n_data-fold them
                 g_layers = jax.tree.map(
-                    lambda x, sh: x * nd if sh
+                    lambda x, dm: x * nd if dm >= 0
                     else jax.lax.psum(x * nd, DATA_AXIS),
-                    g_layers, fsdp_sharded)
+                    g_layers, fsdp_dims)
                 g_embed, g_head = jax.tree.map(
                     lambda x: jax.lax.psum(x * nd, DATA_AXIS),
                     (g_embed, g_head))
@@ -1054,20 +1199,12 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
 
     if moe is not None:
         layer_spec = _moe_layer_specs(cfg, moe, T, n_ep)
-    elif T > 1:
-        # Per-leaf Megatron placement for the stacked layer pytree: heads and
-        # FFN hidden column-split over 'model', o/down row-split; the model
-        # axis slices each device's weight shards, so the stage body sees
-        # local shards and n_heads/T local heads.
-        from .tensor_parallel import pipeline_layer_specs
-        layer_spec = pipeline_layer_specs(cfg, PIPE_AXIS)
-    elif fsdp:
-        # stacked [D, V, lps, w0, ...]: w0 (the first weight dim) sharded
-        # over 'data' for matrix leaves; grads come back in the same layout
-        layer_spec = jax.tree.map(
-            lambda sh: P(PIPE_AXIS, None, None, DATA_AXIS) if sh
-            else P(PIPE_AXIS),
-            fsdp_sharded)
+    elif T > 1 or fsdp:
+        # Per-leaf placement for the stacked layer pytree: Megatron 'model'
+        # placement (heads and FFN hidden column-split, o/down row-split)
+        # merged with the per-leaf fsdp 'data' dims — pp x tp, pp x fsdp,
+        # and pp x fsdp x tp all come from the one helper
+        layer_spec = _dense_layer_specs(cfg, T, fsdp_dims)
     else:
         layer_spec = P(PIPE_AXIS)
     if n_seq > 1:
@@ -1128,6 +1265,7 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                        tp_vocab_parallel: bool = False,
                        fsdp: bool = False,
                        remat_backward=None,
+                       unroll_ticks=None,
                        ) -> Callable[[Pytree, jax.Array, jax.Array],
                                      Tuple[jax.Array, Pytree]]:
     """Jitted ``(params, tokens, targets) -> (loss, grads)`` pipeline step.
@@ -1147,7 +1285,7 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     return jax.jit(make_pipeline_grad_fn(
         cfg, mesh, sched, force_tick_executor=force_tick_executor, moe=moe,
         sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel,
-        fsdp=fsdp, remat_backward=remat_backward))
+        fsdp=fsdp, remat_backward=remat_backward, unroll_ticks=unroll_ticks))
 
 
 def fsdp_shard_params(params: Pytree, cfg: ModelConfig, mesh: Mesh) -> Pytree:
@@ -1165,17 +1303,32 @@ def fsdp_shard_params(params: Pytree, cfg: ModelConfig, mesh: Mesh) -> Pytree:
     if n_data <= 1:
         raise ValueError("fsdp_shard_params needs a 'data' mesh axis to "
                          "shard parameters over (make_mesh(n_data=...))")
-    sharded = _fsdp_sharded_mask(cfg, n_data)
+    T = mesh.shape.get(MODEL_AXIS, 1)
+    dims = _fsdp_shard_dims(cfg, n_data, T)
+    if T > 1:
+        from .tensor_parallel import _layer_specs
+        base = _layer_specs(cfg)
+    else:
+        base = jax.tree.map(lambda _: P(), dims)
 
-    def put_layer(x, sh):
-        spec = (P(PIPE_AXIS, DATA_AXIS) if sh else P(PIPE_AXIS))
-        return jax.device_put(x, NamedSharding(mesh, spec))
+    def put_layer(x, spec, dm):
+        # full-model layer leaves are [L, w0, ...]: 'pipe' on the layer
+        # dim, 'model' per the Megatron spec (T > 1), 'data' on the fsdp
+        # dim — the same resting layout the executor's in/out specs name
+        e = list(tuple(spec))
+        e += [None] * (x.ndim - len(e))
+        e[0] = PIPE_AXIS
+        if dm >= 0:
+            assert e[dm] is None, (spec, dm)
+            e[dm] = DATA_AXIS
+        return jax.device_put(x, NamedSharding(mesh, P(*e)))
 
     return {
         "embed": jax.tree.map(
             lambda x: jax.device_put(x, NamedSharding(mesh, P())),
             params["embed"]),
-        "layers": jax.tree.map(put_layer, params["layers"], sharded),
+        "layers": jax.tree.map(put_layer, params["layers"], base, dims,
+                               is_leaf=lambda x: isinstance(x, P)),
         "head": jax.tree.map(
             lambda x: jax.device_put(x, NamedSharding(mesh, P())),
             params["head"]),
@@ -1230,7 +1383,7 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
                            sched: ScheduleConfig, sp_attn_impl: str,
                            tp_vocab_parallel: bool, fsdp: bool,
                            train_dropout: bool = False,
-                           unroll: bool = False, moe=None):
+                           unroll=False, moe=None):
     """The forward-only tick program (BFS fill-drain over
     ``sched.n_virtual`` wrap-placed chunks; every schedule's forward order
     is fill-drain) shared by the eval loss (:func:`make_pipeline_loss_fn`)
@@ -1239,14 +1392,17 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
     token-mean CE per microbatch and accumulates it; [B, S, V] logits never
     materialize.
 
-    ``unroll`` (requires D == 1, where the table is device-symmetric so
-    every row is compile-time concrete): emit the ticks as a static Python
-    loop instead of a ``lax.scan`` — no slot buffers, no conds, no scan
-    boundary, so XLA fuses across microbatches. Measured 148k vs 107k
-    tok/s for the same 4-microbatch program on one v5e chip: scan
-    boundaries force every residual through HBM, which is the dominant
-    cost of microbatched training at small per-microbatch shapes
-    (docs/performance.md).
+    ``unroll``: emit the ticks as a static Python loop instead of a
+    ``lax.scan``. At D == 1 the table is device-symmetric, so every row is
+    compile-time concrete and the program is pure straight-line code — no
+    slot buffers, no conds, no self-loop ppermute; measured 148k vs 107k
+    tok/s for the same 4-microbatch program on one v5e chip (scan
+    boundaries force every residual through HBM, the dominant cost of
+    microbatched training at small per-microbatch shapes,
+    docs/performance.md). At D > 1 (round 4) slot buffers and per-device
+    column reads stay dynamic, but the scan boundary still disappears and
+    device-uniform ticks lose their conds and dead ring hops — autodiff
+    residuals become per-tick SSA values instead of stacked scan outputs.
 
     Returns ``(spmd_fn, in_specs, D, V)`` where ``spmd_fn(layers_stacked,
     embed, head, tokens, targets[, rng_data])`` -> per-device partial loss
@@ -1277,10 +1433,11 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
                 "dropout is not plumbed through MoE stage bodies")
         if fsdp:
             raise ValueError("fsdp eval composes with dense stages only")
-    if fsdp and (n_data <= 1 or T > 1 or n_seq > 1):
-        raise ValueError("fsdp eval needs a dense data x pipe mesh "
-                         "(matching the training-side pp x fsdp support)")
-    fsdp_sharded = _fsdp_sharded_mask(cfg, n_data) if fsdp else None
+    if fsdp and (n_data <= 1 or n_seq > 1):
+        raise ValueError("fsdp eval needs a dense data x pipe (x model) "
+                         "mesh (matching the training-side pp x fsdp "
+                         "support)")
+    fsdp_dims = _fsdp_shard_dims(cfg, n_data, T) if fsdp else None
     V = sched.n_virtual
     M = sched.n_microbatches
     tp_axis = MODEL_AXIS if T > 1 else None
@@ -1300,10 +1457,11 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
         raise ValueError(f"n_layers={cfg.n_layers} must divide over {S} stages")
     lps = cfg.n_layers // S
     uniform_units = sp_axis is not None and sp_attn_impl == "ring"
-    if unroll and D != 1:
-        raise ValueError("unroll=True requires a 1-device pipe axis (the "
-                         "table is only device-symmetric at D == 1)")
     table_np, n_slots = _fwd_tick_table(D, V, M)
+    if unroll is None:
+        # auto: D == 1 always unrolls (measured fastest); D > 1 up to the
+        # same tick-row budget as the training executor's unroll_ticks
+        unroll = D == 1 or table_np.shape[0] <= _UNROLL_TICKS_LIMIT
     table = jnp.asarray(table_np)
     dtype = jnp.dtype(cfg.dtype)
     fwd_perm = [(i, (i + 1) % D) for i in range(D)]
@@ -1345,9 +1503,10 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
                 # JIT all-gather of just this chunk's weights (the same
                 # per-tick residency bound as the training executor)
                 layer_p = jax.tree.map(
-                    lambda x_, sh: jax.lax.all_gather(
-                        x_, DATA_AXIS, axis=1, tiled=True) if sh else x_,
-                    layer_p, fsdp_sharded)
+                    lambda x_, dm: jax.lax.all_gather(
+                        x_, DATA_AXIS, axis=dm, tiled=True) if dm >= 0
+                    else x_,
+                    layer_p, fsdp_dims)
             if moe is not None:
                 from ..models.moe import moe_layer_apply
 
@@ -1398,7 +1557,7 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
                 else None,
                 loss_norm=loss_norm)
 
-        if unroll:
+        if unroll and D == 1:
             # D == 1: every table row is concrete, so the tick loop lowers
             # to straight-line code — slots become Python variables, conds
             # become Python ifs, the self-loop ppermute disappears
@@ -1426,16 +1585,21 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
 
         masked_store = _masked_store
 
-        def run_unit(pred, unit, noop, operand):
+        def run_unit(pred, unit, noop, operand, know=None):
+            if know is True:
+                return unit(operand)
+            if know is False:
+                return noop(operand)
             if not uniform_units:
                 return jax.lax.cond(pred, unit, noop, operand)
             return jax.tree.map(lambda n, o: jnp.where(pred, n, o),
                                 unit(operand), noop(operand))
 
-        def tick(carry, row_all):
+        def tick(carry, row_all, concrete=None, next_concrete=None):
             act_buf, recv, loss_acc = carry
             row = row_all[d]
-            act_buf = masked_store(act_buf, recv, row[0])
+            if concrete is None or (concrete[:, 0] >= 0).any():
+                act_buf = masked_store(act_buf, recv, row[0])
             fv, fm, src = row[1], row[2], row[3]
 
             def fwd_unit(act_buf):
@@ -1454,26 +1618,40 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
                 return (jnp.zeros(mb_shape, dtype),
                         jnp.zeros((), jnp.float32))
 
-            y, l = run_unit(fm >= 0, fwd_unit, fwd_noop, act_buf)
-            return (act_buf, jax.lax.ppermute(y, PIPE_AXIS, fwd_perm),
-                    loss_acc + l), None
+            y, l = run_unit(fm >= 0, fwd_unit, fwd_noop, act_buf,
+                            know=_concrete_know(
+                                None if concrete is None else concrete[:, 2]))
+            if next_concrete is not None and (next_concrete[:, 0] < 0).all():
+                nxt_recv = jnp.zeros(mb_shape, dtype)  # hop elided: dead
+            else:
+                nxt_recv = jax.lax.ppermute(y, PIPE_AXIS, fwd_perm)
+            return (act_buf, nxt_recv, loss_acc + l), None
 
         carry0 = (jnp.zeros((n_slots,) + mb_shape, dtype),
                   jnp.zeros(mb_shape, dtype),
                   jnp.zeros((), jnp.float32))
-        (_, _, loss), _ = jax.lax.scan(tick, carry0, table)
+        if unroll:
+            # D > 1 unrolled: the tick loop is a Python loop over concrete
+            # rows — slot buffers and per-device column reads stay dynamic,
+            # but the scan boundary disappears and device-uniform ticks
+            # lose their conds/hops (mirrors the training executor's
+            # unroll_ticks; VERDICT r3 item 2)
+            carry = carry0
+            n_rows = table_np.shape[0]
+            end_row = np.full_like(table_np[0], -1)
+            for t in range(n_rows):
+                nxt = table_np[t + 1] if t + 1 < n_rows else end_row
+                carry, _ = tick(carry, table[t], concrete=table_np[t],
+                                next_concrete=nxt)
+        else:
+            carry, _ = jax.lax.scan(tick, carry0, table)
+        (_, _, loss) = carry
         return loss / M  # per-device partial (non-last stages: 0)
 
     if moe is not None:
         layer_spec = _moe_layer_specs(cfg, moe, T, n_ep)
-    elif T > 1:
-        from .tensor_parallel import pipeline_layer_specs
-        layer_spec = pipeline_layer_specs(cfg, PIPE_AXIS)
-    elif fsdp:
-        layer_spec = jax.tree.map(
-            lambda sh: P(PIPE_AXIS, None, None, DATA_AXIS) if sh
-            else P(PIPE_AXIS),
-            fsdp_sharded)
+    elif T > 1 or fsdp:
+        layer_spec = _dense_layer_specs(cfg, T, fsdp_dims)
     else:
         layer_spec = P(PIPE_AXIS)
     if tp_vocab_parallel and not cfg.tie_embeddings:
@@ -1562,8 +1740,9 @@ def _make_phase_stored_grad_fn(cfg: ModelConfig, mesh: Mesh,
     These schedules run, per device, every forward before any backward —
     so the backward tick order is exactly the time-reversal of the forward
     program, which is precisely what ``jax.value_and_grad`` produces: XLA
-    banks each tick's residuals (as static scan outputs at D > 1; as
-    ordinary fused SSA values in the D == 1 unrolled program), the
+    banks each tick's residuals (ordinary fused SSA values in the unrolled
+    program — D == 1's straight-line form or round 4's D > 1 Python tick
+    loop — static scan outputs only beyond the unroll budget), the
     generated backward replays them in reverse, and the transposed
     ``ppermute`` IS the gradient ring (+1 forward ring transposes to the
     -1 grad ring). This matches the reference's torch-autograd semantics
@@ -1572,17 +1751,18 @@ def _make_phase_stored_grad_fn(cfg: ModelConfig, mesh: Mesh,
     ``stage.py:857/937``). Activation residency is O(M) microbatches —
     GPipe's own requirement; schedules whose point is O(D) residency
     (1F1B/Interleaved) interleave B among F and cannot use this path at
-    D > 1. Single-chip measurements (v5e, docs/performance.md): the
-    unrolled D == 1 form is the FASTEST executor formulation (~1.25x over
-    the remat tick scan); the scanned D > 1 form measures SLOWER than
-    remat (scan-boundary residual traffic), hence it is opt-in via
-    ``remat_backward=False``.
+    D > 1 (their stored backward is the slot-banked tick executor, which
+    round 4 also unrolls — ``unroll_ticks``). Single-chip measurements
+    (v5e, docs/performance.md): the unrolled D == 1 form is the FASTEST
+    executor formulation (~1.25x over the remat tick scan); the scanned
+    D > 1 form measures SLOWER than remat (scan-boundary residual
+    traffic), hence stored remains opt-in via ``remat_backward=False`` —
+    now served by the unrolled form wherever the tick budget allows.
     """
     use_dropout = cfg.dropout > 0.0
     spmd_fn, in_specs, D, V = _build_forward_program(
         cfg, mesh, sched, sp_attn_impl, tp_vocab_parallel, False,
-        train_dropout=use_dropout,
-        unroll=mesh.shape[PIPE_AXIS] == 1)
+        train_dropout=use_dropout, unroll=None)
     n_data = mesh.shape.get(DATA_AXIS, 1)
     n_seq = mesh.shape.get(SEQ_AXIS, 1)
 
