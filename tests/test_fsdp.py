@@ -133,8 +133,9 @@ def test_pp_fsdp_validation():
         make_pipeline_step(cfg, make_mesh(n_pipe=2),
                            dtpp.ScheduleConfig(name="GPipe",
                                                n_microbatches=2), fsdp=True)
-    with pytest.raises(NotImplementedError, match="fsdp"):
-        make_pipeline_step(cfg, make_mesh(n_pipe=2, n_data=2, n_model=2),
+    # a 'model' axis now COMPOSES with fsdp (round 4); seq still raises
+    with pytest.raises(NotImplementedError, match="seq"):
+        make_pipeline_step(cfg, make_mesh(n_pipe=2, n_data=2, n_seq=2),
                            dtpp.ScheduleConfig(name="GPipe",
                                                n_microbatches=2), fsdp=True)
 
@@ -215,3 +216,50 @@ def test_zero1_opt_state_sharding_is_transparent():
     err = max(jax.tree.leaves(jax.tree.map(
         lambda a, b: float(jnp.max(jnp.abs(a - b))), p_rep, p_sh)))
     assert err < 1e-6
+
+
+def test_pp_fsdp_tp_matches_single_device():
+    """Round-4 guard closure (VERDICT r3 item 4a): pp x fsdp x TP on a 3-D
+    data x pipe x model mesh. Each matrix leaf carries TWO sharding axes —
+    'model' on its Megatron dim, 'data' on a different dim
+    (_fsdp_shard_dims) — with the per-tick gather/scatter riding the
+    per-leaf dims. Loss/grads still equal single-device autodiff, and both
+    params and returned grads genuinely rest doubly sharded."""
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+        make_mesh)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        fsdp_shard_params, make_pipeline_loss_fn, make_pipeline_step)
+
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=32, arch="gpt2")
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (8, 16), 0, cfg.vocab_size)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(cfg, p, tokens, targets))(params)
+
+    mesh = make_mesh(n_pipe=2, n_data=2, n_model=2)
+    placed = fsdp_shard_params(params, cfg, mesh)
+    # lin1 w [L=4, dim=32, ffn=64]: column-parallel 'model' on ffn, fsdp
+    # 'data' on dim -> per-device (L/2, 16, 32)
+    w = placed["layers"]["lin1"]["w"]
+    assert {s.data.shape for s in w.addressable_shards} == {(2, 16, 32)}
+    # lin2 w [L, ffn=64, dim=32]: row-parallel 'model' on ffn, so fsdp
+    # must pick the OTHER dim -> (L/2, 32, 16)
+    w2 = placed["layers"]["lin2"]["w"]
+    assert {s.data.shape for s in w2.addressable_shards} == {(2, 32, 16)}
+    for name, M in (("1F1B", 4), ("GPipe", 2)):
+        step = make_pipeline_step(
+            cfg, mesh, dtpp.ScheduleConfig(name=name, n_microbatches=M),
+            fsdp=True)
+        loss, grads = step(placed, tokens, targets)
+        assert float(jnp.abs(loss - ref_loss)) < 2e-5, name
+        err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                           grads, ref_grads)
+        assert max(jax.tree.leaves(err)) < 2e-5, name
+        gw = grads["layers"]["lin1"]["w"]
+        assert {s.data.shape for s in gw.addressable_shards} == {(2, 16, 32)}
+    ev = make_pipeline_loss_fn(
+        cfg, mesh, dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
+        fsdp=True)
+    assert float(jnp.abs(ev(placed, tokens, targets) - ref_loss)) < 2e-5
