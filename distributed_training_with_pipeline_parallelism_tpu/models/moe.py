@@ -207,18 +207,38 @@ def moe_layer_init(key: jax.Array, cfg: ModelConfig, moe: MoEConfig) -> Dict:
 def moe_layer_apply(cfg: ModelConfig, moe: MoEConfig, params: Dict,
                     h: jax.Array, axis_name: Optional[str] = None,
                     tp_axis: Optional[str] = None,
-                    tp_size: int = 1) -> Tuple[jax.Array, jax.Array]:
+                    tp_size: int = 1,
+                    rng: Optional[jax.Array] = None,
+                    ) -> Tuple[jax.Array, jax.Array]:
     """One MoE decoder block. ``axis_name`` shards experts (EP);
     ``tp_axis``/``tp_size`` additionally Megatron-shards the attention
     heads and each expert's ffn dim over the model axis — EP moves whole
     experts across devices, TP splits every expert's matmuls, and the two
-    compose (each expert shard group runs its ffn slice)."""
+    compose (each expert shard group runs its ffn slice).
+
+    ``rng`` (train mode, round 4) enables dropout at the dense gpt2
+    block's sites: attention probabilities (stream 0), the attention
+    residual (1), and the MoE-FFN residual (2). The FFN mask lands on the
+    COMBINED expert output — position-wise on [B, S, d] — not on
+    per-expert slot blocks, so it is invariant to the EP/TP partitioning
+    by construction (no per-expert-slot mask streams needed) and follows
+    the same (key, shard, microbatch, layer, site) convention as the
+    dense executor (tests/test_moe_pipeline.py asserts the partition
+    invariance)."""
+    from ..ops.layers import dropout_apply
+    p = cfg.dropout if rng is not None else 0.0
+
+    def site(i: int) -> Optional[jax.Array]:
+        return None if rng is None else jax.random.fold_in(rng, i)
+
     a = layer_norm_apply(params["ln1"], h)
-    h = h + mha_apply(params["attn"], a, a, cfg.n_heads // tp_size,
-                      causal=True, tp_axis=tp_axis, tp_size=tp_size)
+    attn = mha_apply(params["attn"], a, a, cfg.n_heads // tp_size,
+                     causal=True, tp_axis=tp_axis, tp_size=tp_size,
+                     dropout_rate=p, dropout_rng=site(0))
+    h = h + dropout_apply(attn, p, site(1))
     m = layer_norm_apply(params["ln2"], h)
     y, aux = moe_ffn_apply(params["moe"], m, moe, axis_name, tp_axis)
-    return h + y, aux
+    return h + dropout_apply(y, p, site(2)), aux
 
 
 def moe_lm_init(key: jax.Array, cfg: ModelConfig, moe: MoEConfig) -> Dict:
@@ -229,8 +249,11 @@ def moe_lm_init(key: jax.Array, cfg: ModelConfig, moe: MoEConfig) -> Dict:
     }
     layers = jax.vmap(lambda k: moe_layer_init(k, cfg, moe))(
         jax.random.split(kl, cfg.n_layers))
-    head = {"norm": layer_norm_init(cfg.dim),
-            "out": linear_init(ko, cfg.dim, cfg.vocab_size, bias=False)}
+    # tied embeddings (round 4): like transformer_init, the head is only
+    # the norm — the vocab matmul reuses embed["tok"] (head_apply)
+    head = {"norm": layer_norm_init(cfg.dim)}
+    if not cfg.tie_embeddings:
+        head["out"] = linear_init(ko, cfg.dim, cfg.vocab_size, bias=False)
     params = {"embed": embed, "layers": layers, "head": head}
     dtype = jnp.dtype(cfg.dtype)
     if dtype != jnp.float32:
@@ -242,12 +265,10 @@ def moe_lm_logits_aux(cfg: ModelConfig, moe: MoEConfig, params: Dict,
                       tokens: jax.Array,
                       axis_name: Optional[str] = None):
     """MoE LM forward: -> (logits [B, S, V], summed per-layer aux loss).
-    The shared core of :func:`moe_lm_loss` and test oracles."""
-    if cfg.tie_embeddings:
-        raise NotImplementedError(
-            "tie_embeddings is not implemented for MoE models (moe_lm_init "
-            "builds its own untied head); silently training untied would "
-            "ignore the requested weight sharing")
+    The shared core of :func:`moe_lm_loss` and test oracles. With
+    ``cfg.tie_embeddings`` the vocab matmul reuses the embedding table
+    (round 4 — the pipeline executor's MoE stages share the same
+    ``_stage_ce`` tied-head path)."""
     if cfg.embed_scale:
         raise NotImplementedError(
             "embed_scale is not implemented for the MoE loss; mirror the "
@@ -263,8 +284,10 @@ def moe_lm_logits_aux(cfg: ModelConfig, moe: MoEConfig, params: Dict,
 
     (h, aux), _ = jax.lax.scan(step, (h, jnp.zeros((), jnp.float32)),
                                params["layers"])
-    logits = linear_apply(params["head"]["out"],
-                          layer_norm_apply(params["head"]["norm"], h))
+    from .transformer import head_apply
+    logits = head_apply(cfg, params["head"], h,
+                        embed=params["embed"] if cfg.tie_embeddings
+                        else None)
     return logits, aux
 
 
