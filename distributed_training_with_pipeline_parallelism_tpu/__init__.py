@@ -29,6 +29,8 @@ _LAZY = {
     "make_pipeline_grad_fn": ("parallel.pipeline", "make_pipeline_grad_fn"),
     "make_pipeline_loss_fn": ("parallel.pipeline", "make_pipeline_loss_fn"),
     "make_pipeline_forward": ("parallel.pipeline", "make_pipeline_forward"),
+    "make_pipeline_generate_fn": ("parallel.pipelined_decode",
+                                  "make_pipeline_generate_fn"),
     "fsdp_shard_params": ("parallel.pipeline", "fsdp_shard_params"),
     "register_schedule": ("parallel.schedules", "register_schedule"),
     "compile_schedule": ("parallel.schedules", "compile_schedule"),

