@@ -126,6 +126,13 @@ def ring_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
     b, s, _ = q_in.shape
     q_in, kv_in = tp_attention_inputs(q_in, kv_in, tp_axis)
     q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles)
+    if dropout_rng is not None and tp_axis is not None:
+        # each model rank holds a DIFFERENT head shard, so its attention
+        # dropout must draw a distinct stream — without this fold every TP
+        # rank reuses one mask across head groups (head i and head i+h/T
+        # correlate) and the realized mask depends on the TP degree
+        dropout_rng = jax.random.fold_in(dropout_rng,
+                                         jax.lax.axis_index(tp_axis))
     out = ring_attention(q, k, v, axis_name, causal=causal,
                          dropout_rate=dropout_rate, dropout_rng=dropout_rng)
     return tp_output_projection(params["o"], out.reshape(b, s, -1), tp_axis)

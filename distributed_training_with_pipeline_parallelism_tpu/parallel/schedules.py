@@ -959,6 +959,28 @@ def analytic_bubble_fraction(name: str, n_devices: int, n_virtual: int,
     return (D - 1) / (M * V + D - 1)
 
 
+def paper_bubble_fraction(name: str, n_devices: int, n_virtual: int,
+                          n_microbatches: int) -> float:
+    """The PAPER-comparable bubble under uniform-work accounting.
+
+    :func:`analytic_bubble_fraction`'s ZB numbers price device 0's elided
+    dgrad as idle (this executor genuinely skips it — a work saving the
+    per-device mean counts as bubble), so they are NOT comparable to the
+    zero-bubble paper's figures or to this repo's pre-round-3 reports.
+    This twin reports the classic ``1 - uniform_busy/makespan`` form on the
+    same makespans — ``(D-1)/(3M+D-1)`` for ZB-H1, ``(D-1)/(6M+D-1)`` for
+    ZB-V — and equals :func:`analytic_bubble_fraction` for every other
+    builtin. Sweep CSVs / docs citing a ZB bubble should say which form
+    they use (docs/schedules.md shows both)."""
+    D, M = n_devices, n_microbatches
+    if name == "ZBH1":
+        return (D - 1) / (3 * M + D - 1)
+    if name == "ZBV":
+        return (D - 1) / (6 * M + D - 1)
+    return analytic_bubble_fraction(name, n_devices, n_virtual,
+                                    n_microbatches)
+
+
 def simulated_bubble(cs: CompiledSchedule, w_f: float = 1.0,
                      w_b: float = 2.0, w_w: float = 1.0) -> Dict[str, float]:
     """Bubble measured on the compiled tick schedule under a cost model where

@@ -98,19 +98,47 @@ def parse(log_dir: str, n_steps: int) -> dict:
     sp = xplane_pb2.XSpace()
     with open(path, "rb") as f:
         sp.ParseFromString(f.read())
-    plane = next(p for p in sp.planes if "TPU" in p.name)
+    device_planes = [p for p in sp.planes if "TPU" in p.name]
+    if not device_planes:
+        raise SystemExit(
+            f"no TPU device plane in {path} — planes: "
+            f"{[p.name for p in sp.planes]} (CPU-only trace?)")
+    # multiple device planes (a multi-chip host): take the busiest one —
+    # SPMD timelines are symmetric, so one plane is representative
+    plane = max(device_planes,
+                key=lambda p: sum(ev.duration_ps for l in p.lines
+                                  for ev in l.events))
     sm = plane.stat_metadata
     md = plane.event_metadata
 
     def md_stats(m):
-        return {sm[s.metadata_id].name: (s.str_value or s.int64_value
-                                         or s.uint64_value)
-                for s in m.stats}
+        out = {}
+        for s in m.stats:
+            # branch on the populated value case; an `or`-chain would
+            # coalesce legitimate zeros into the next field
+            for field in ("str_value", "int64_value", "uint64_value",
+                          "double_value"):
+                if s.HasField(field):
+                    out[sm[s.metadata_id].name] = getattr(s, field)
+                    break
+        return out
 
-    steps_line = next(l for l in plane.lines if l.name == "Steps")
-    step_s = sum(ev.duration_ps for ev in steps_line.events) / 1e12 / n_steps
+    steps_line = next((l for l in plane.lines if l.name == "Steps"), None)
+    ops_line = next((l for l in plane.lines if l.name == "XLA Ops"), None)
+    if ops_line is None:
+        raise SystemExit(
+            f"no 'XLA Ops' line on plane {plane.name!r} — lines: "
+            f"{[l.name for l in plane.lines]}")
+    if steps_line is not None and steps_line.events:
+        step_s = (sum(ev.duration_ps for ev in steps_line.events)
+                  / 1e12 / n_steps)
+    else:
+        # no step markers (e.g. a trace without annotated steps): fall back
+        # to the op-timeline span, which bounds the per-step device time
+        lo = min(ev.offset_ps for ev in ops_line.events)
+        hi = max(ev.offset_ps + ev.duration_ps for ev in ops_line.events)
+        step_s = (hi - lo) / 1e12 / n_steps
 
-    ops_line = next(l for l in plane.lines if l.name == "XLA Ops")
     cats = collections.defaultdict(lambda: [0.0, 0.0, 0.0])  # t, flops, bytes
     tops = collections.Counter()
     src_of = {}

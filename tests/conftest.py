@@ -35,9 +35,9 @@ jax.config.update("jax_platforms", "cpu")
 # backend_compile after a few hundred compilations in ONE process (observed
 # twice at ~88% of the full suite, in jax compiler.py
 # backend_compile_and_load; the same test passes in a fresh interpreter).
-# If a full `pytest tests/` run segfaults deep in, split it into two
-# processes (e.g. alphabetically) rather than chasing the crash — it is an
-# XLA:CPU process-longevity issue, not a test bug. `-m smoke` is unaffected.
+# The tooled answer is `python scripts/run_tests.py`: the full suite in a
+# few fresh-interpreter shards, one verdict — it is an XLA:CPU
+# process-longevity issue, not a test bug. `-m smoke` is unaffected.
 if "tempfile" in dir():  # keep the import satisfied for future use
     pass
 

@@ -10,6 +10,8 @@ pipeline run's loss/grads independent of how stages are partitioned and
 makes the rematerializing backward consistent with its forward.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import pytest
@@ -189,14 +191,34 @@ def test_pipeline_dropout_with_sequence_parallel():
     assert max(jax.tree.leaves(err)) < 1e-5
     # ring attention also trains with dropout (blockwise masks keyed on
     # global chunk coordinates — a different but equally valid mask layout,
-    # so only finiteness and train/eval divergence are asserted here; the
-    # exact blockwise-mask oracle lives in tests/test_ring_attention.py)
+    # so the exact mask values are asserted against the blockwise oracle in
+    # tests/test_ring_attention.py; HERE the per-microbatch rng THREADING
+    # through the pipeline executor is what's under test)
     ring_step = make_pipeline_step(cfg, make_mesh(n_pipe=2, n_seq=2), sched,
                                    sp_attn_impl="ring")
     ring_loss, ring_grads = jax.device_get(ring_step(params, tokens, targets,
                                                      rng))
     assert np.isfinite(ring_loss)
     assert all(np.all(np.isfinite(g)) for g in jax.tree.leaves(ring_grads))
+    # Distinct masks per microbatch: swapping the two microbatches' data
+    # changes the (data, mask) pairing and therefore the loss. The mean CE
+    # itself is microbatch-permutation-INVARIANT (checked in eval mode), so
+    # a change can come only from the folded per-microbatch streams — an
+    # executor that reused one ring-dropout mask for every microbatch would
+    # leave the permuted loss identical.
+    perm_tokens = jnp.concatenate([tokens[2:], tokens[:2]])
+    perm_targets = jnp.concatenate([targets[2:], targets[:2]])
+    ring_loss_perm = jax.device_get(
+        ring_step(params, perm_tokens, perm_targets, rng)[0])
+    assert abs(ring_loss_perm - ring_loss) > 1e-6, (
+        "microbatch-permuted ring-dropout loss identical: the executor is "
+        "reusing one dropout mask across microbatches")
+    eval_cfg = dataclasses.replace(cfg, dropout=0.0)
+    eval_step = make_pipeline_step(eval_cfg, make_mesh(n_pipe=2, n_seq=2),
+                                   sched, sp_attn_impl="ring")
+    e0 = jax.device_get(eval_step(params, tokens, targets)[0])
+    e1 = jax.device_get(eval_step(params, perm_tokens, perm_targets)[0])
+    assert abs(e0 - e1) < 1e-6  # invariance holds without dropout
 
 
 def test_train_step_with_dropout_smoke():

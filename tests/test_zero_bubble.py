@@ -123,3 +123,26 @@ def test_bubble_north_star_closed_forms(name, V, cases):
         sim = simulated_bubble(cs, w_f=1.0, w_b=1.0, w_w=1.0)["bubble_fraction"]
         an = analytic_bubble_fraction(name, D, V, M, cs=cs)
         assert sim == pytest.approx(an, abs=1e-9), (name, D, M, sim, an)
+
+
+def test_paper_bubble_fraction_dual_form():
+    """The paper-comparable dual (ADVICE r3): uniform-work accounting on the
+    same makespans — (D-1)/(3M+D-1) for ZB-H1, (D-1)/(6M+D-1) for ZB-V —
+    strictly below the executor form (which prices device 0's elided dgrad
+    as idle), and identical to analytic_bubble_fraction for every other
+    builtin schedule."""
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules import (
+        analytic_bubble_fraction, paper_bubble_fraction)
+    for D, M in [(2, 4), (4, 8), (8, 16)]:
+        assert paper_bubble_fraction("ZBH1", D, 1, M) == pytest.approx(
+            (D - 1) / (3 * M + D - 1))
+        assert paper_bubble_fraction("ZBV", D, 2, M) == pytest.approx(
+            (D - 1) / (6 * M + D - 1))
+        assert (paper_bubble_fraction("ZBH1", D, 1, M)
+                < analytic_bubble_fraction("ZBH1", D, 1, M))
+        assert (paper_bubble_fraction("ZBV", D, 2, M)
+                < analytic_bubble_fraction("ZBV", D, 2, M))
+        for name, V in [("GPipe", 1), ("1F1B", 1), ("Interleaved1F1B", 2),
+                        ("BFS", 2)]:
+            assert paper_bubble_fraction(name, D, V, M) == (
+                analytic_bubble_fraction(name, D, V, M))
