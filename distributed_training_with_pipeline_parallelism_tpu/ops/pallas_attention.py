@@ -39,14 +39,18 @@ Measured kernel disciplines (rounds 3-4, one v5e chip — docs/profiles/):
      q tile (``qc = q * scale*log2e``), so the per-element path is
      ``exp2(s2 - m2)`` with no multiply — the saved lse is log2-domain
      (internal: it only ever feeds these backward kernels).
-  2. **cond-gated masking**: the row-col difference tile is computed once
-     per grid instance (k-block-invariant) and each edge is one
-     scalar-broadcast compare, but the compare+select is *executed* only
-     on blocks that can actually mask (diagonal-crossing, padded-tail, or
-     window-edge blocks) via a scalar `lax.cond`; interior blocks skip the
-     mask entirely. Masked scores go to NEG_INF so ``exp2`` underflows
-     dead elements to exactly 0.0; dead-row guards are only paid where a
-     fully-dead first block is reachable (a sliding window's left edge).
+  2. **static diagonal split**: on the plain causal training path
+     (bq == bk, no padding/window) the one diagonal block per loop is
+     peeled out STATICALLY — interior blocks run with no mask arithmetic
+     at all, and the diagonal applies a precomputed additive 0/NEG_INF
+     tile (one add/elem instead of compare+select). A scalar `lax.cond`
+     gate was measured SLOWER (it costs Mosaic its k-loop software
+     pipelining: fwd 1.16 -> 1.66 ms at gpt2-small shapes); the static
+     peel has no branch. Other paths keep the k-block-invariant
+     difference-tile mask (one compare per edge, scalar-broadcast).
+     Masked scores go to NEG_INF so ``exp2`` underflows dead elements to
+     exactly 0.0; dead-row guards are only paid where a fully-dead first
+     block is reachable (a sliding window's left edge).
   3. **one-sweep backward**: dq, dk and dv come out of a single kernel
      gridded over k blocks. The q-block loop accumulates dk/dv in
      registers and dq into a grid-revisited f32 VMEM output block
@@ -111,35 +115,6 @@ def _make_block_mask(qi_base, block_shape, causal: bool, true_len: int,
     return mask
 
 
-def _maybe_mask(mask, s, qi, ki, block_q: int, block_k: int, causal: bool,
-                n_kv, true_len: int, seq_len: int, window: Optional[int]):
-    """Apply the score mask only on blocks that can actually mask.
-
-    For the plain-causal / padded-tail cases the masking blocks are exactly
-    the diagonal-crossing blocks and the last (padded) k block; everything
-    strictly below the diagonal is fully live, and a scalar `lax.cond`
-    skips its compare+select (2 VPU ops per score element) entirely. A
-    sliding window also masks at its left edge, so the window path applies
-    the mask unconditionally (window blocks are few by construction)."""
-    if mask is None:
-        return s
-    if window is not None:
-        return mask(s, ki * block_k)
-    need = None
-    if causal:
-        # block crosses the diagonal iff its newest key can exceed the
-        # oldest query row: (ki+1)*bk - 1 > qi*bq - 1
-        need = (ki + 1) * block_k > qi * block_q
-    if true_len != seq_len:
-        # any block whose tail reaches past true_len holds padded keys —
-        # with block_q > block_k (s padded to the lcm) that can be several
-        # trailing blocks, not just the last one
-        pad = (ki + 1) * block_k > true_len
-        need = pad if need is None else need | pad
-    return jax.lax.cond(need, lambda x: mask(x, ki * block_k),
-                        lambda x: x, s)
-
-
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                       causal: bool, scale: float, seq_len: int,
                       true_len: int, window: Optional[int]):
@@ -173,32 +148,49 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     # q block may open strictly later than kv_start. Only that case pays
     # the dead-row guards.
     guard_dead_rows = window is not None
+    # Static diagonal split (the plain causal/full training path,
+    # bq == bk, no padding/window): interior blocks are fully live — NO
+    # mask arithmetic at all — and the single diagonal block applies a
+    # precomputed ADDITIVE tile (one add/elem instead of compare+select).
+    diag_split = (causal and block_q == block_k and true_len == seq_len
+                  and window is None)
 
-    def body(ki, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :]
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, bk] f32, log2-domain
-        s = _maybe_mask(mask, s, qi, ki, block_q, block_k, causal, n_kv,
-                        true_len, seq_len, window)
-        m_blk = jnp.max(s, axis=1)
-        m_new = jnp.maximum(m, m_blk)
-        p = jnp.exp2(s - m_new[:, None])
-        alpha = jnp.exp2(m - m_new)
-        if guard_dead_rows:
-            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-            alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
-        l_new = l * alpha + jnp.sum(p, axis=1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+    def make_body(msk):
+        def body(ki, carry):
+            m, l, acc = carry
+            k = k_ref[0, pl.ds(ki * block_k, block_k), :]
+            v = v_ref[0, pl.ds(ki * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [bq, bk] log2-domain
+            if msk is not None:
+                s = msk(s, ki * block_k)
+            m_blk = jnp.max(s, axis=1)
+            m_new = jnp.maximum(m, m_blk)
+            p = jnp.exp2(s - m_new[:, None])
+            alpha = jnp.exp2(m - m_new)
+            if guard_dead_rows:
+                p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+                alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
+            l_new = l * alpha + jnp.sum(p, axis=1)
+            acc_new = acc * alpha[:, None] + jax.lax.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+        return body
 
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, dh), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(kv_start, n_kv_live, body, (m0, l0, acc0))
+    carry0 = (jnp.full((block_q,), NEG_INF, jnp.float32),
+              jnp.zeros((block_q,), jnp.float32),
+              jnp.zeros((block_q, dh), jnp.float32))
+    if diag_split:
+        # diagonal tile: rc >= 0 is instance-invariant at bq == bk
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        diag_add = jnp.where(rows >= cols, 0.0, NEG_INF)
+        m, l, acc = jax.lax.fori_loop(0, qi, make_body(None), carry0)
+        m, l, acc = make_body(lambda s, _: s + diag_add)(qi, (m, l, acc))
+    else:
+        m, l, acc = jax.lax.fori_loop(kv_start, n_kv_live, make_body(mask),
+                                      carry0)
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
     # per-row logsumexp of the (scaled, masked) scores, in LOG2 domain
@@ -308,56 +300,58 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             keep = pad_cols if keep is None else keep & pad_cols
         return jnp.where(keep, s, NEG_INF)
 
-    def body(qi, carry):
-        dk_acc, dv_acc = carry
-        qs = q_ref[0, pl.ds(qi * block_q, block_q), :]  # unscaled
-        qc = (qs.astype(jnp.float32) * c).astype(qs.dtype)
-        do = do_ref[0, pl.ds(qi * block_q, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]   # log2-domain
-        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
-        s = jax.lax.dot_general(
-            qc, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, bk] f32, log2-domain
-        if mask_needed:
-            if window is None:
-                # cond-gate: only diagonal-crossing / padded-tail blocks
-                # mask (see _maybe_mask; window blocks mask unconditionally)
-                need = None
-                if causal:
-                    need = qi * block_q < (ki + 1) * block_k
-                if pad_cols is not None:
-                    # see _maybe_mask: every trailing block reaching past
-                    # true_len holds padded keys, not only the last one
-                    pad = (ki + 1) * block_k > true_len
-                    need = pad if need is None else need | pad
-                s = jax.lax.cond(need, lambda x: apply_mask(x, qi),
-                                 lambda x: x, s)
-            else:
-                s = apply_mask(s, qi)
-        # padded q rows carry do = 0, so their (finite-garbage) p rows
-        # contribute exactly 0 everywhere; dead elements underflow to 0
-        # (every live row's lse is finite — its diagonal is always live)
-        p = jnp.exp2(s - lse[:, None])
-        dv_new = dv_acc + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, bk] f32
-        ds = p * (dp - delta[:, None])
-        dsb = ds.astype(qs.dtype)
-        dk_new = dk_acc + jax.lax.dot_general(
-            dsb, qs, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        # dq rides unscaled f32; the caller applies `scale` (fused by XLA
-        # into the cast/transpose that follows the kernel)
-        dq_ref[0, pl.ds(qi * block_q, block_q), :] += jax.lax.dot(
-            dsb, k, preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+    def make_body(msk):
+        def body(qi, carry):
+            dk_acc, dv_acc = carry
+            qs = q_ref[0, pl.ds(qi * block_q, block_q), :]  # unscaled
+            qc = (qs.astype(jnp.float32) * c).astype(qs.dtype)
+            do = do_ref[0, pl.ds(qi * block_q, block_q), :]
+            lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]  # log2-domain
+            delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+            s = jax.lax.dot_general(
+                qc, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [bq, bk] log2-domain
+            if msk is not None:
+                s = msk(s, qi)
+            # padded q rows carry do = 0, so their (finite-garbage) p rows
+            # contribute exactly 0 everywhere; dead elements underflow to 0
+            # (every live row's lse is finite — its diagonal is always live)
+            p = jnp.exp2(s - lse[:, None])
+            dv_new = dv_acc + jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [bq, bk] f32
+            ds = p * (dp - delta[:, None])
+            dsb = ds.astype(qs.dtype)
+            dk_new = dk_acc + jax.lax.dot_general(
+                dsb, qs, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            # dq rides unscaled f32; the caller applies `scale` (fused by
+            # XLA into the cast/transpose that follows the kernel)
+            dq_ref[0, pl.ds(qi * block_q, block_q), :] += jax.lax.dot(
+                dsb, k, preferred_element_type=jnp.float32)
+            return dk_new, dv_new
+        return body
 
     dk0 = jnp.zeros((block_k, dh), jnp.float32)
     dv0 = jnp.zeros((block_k, dh), jnp.float32)
-    dk, dv = jax.lax.fori_loop(q_start, q_stop, body, (dk0, dv0))
+    # Static diagonal split, mirroring the forward: with bq == bk on the
+    # plain causal/full path this instance's FIRST live q block (qi == ki)
+    # is the diagonal — an instance-invariant additive tile — and every
+    # later q block is fully live with no mask arithmetic at all.
+    diag_split = (causal and block_q == block_k and true_len == seq_len
+                  and window is None)
+    if diag_split:
+        diag_add = jnp.where(rc_k >= -ki * block_q, 0.0, NEG_INF)
+        carry = make_body(lambda s, _: s + diag_add)(q_start, (dk0, dv0))
+        dk, dv = jax.lax.fori_loop(q_start + 1, q_stop, make_body(None),
+                                   carry)
+    else:
+        dk, dv = jax.lax.fori_loop(
+            q_start, q_stop,
+            make_body(apply_mask if mask_needed else None), (dk0, dv0))
     # qs was unscaled in the dk dot, so the scale applies once here
     dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
@@ -443,11 +437,19 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def _auto_block(s: int) -> int:
-    """Default kernel block: 512 measured up to ~20% (fwd) / ~34% (grad)
-    faster per row than 256 on v5e at seq 1024-4096 (docs/performance.md).
-    Estimated time ~ padded_length / per-row-speed, so 256 wins only where
-    its padding saving exceeds 512's ~1.2x per-row advantage (s=1280:
-    1280 vs 1536/1.2 -> 256; s=2600: 2816 vs 3072/1.2 -> 512)."""
+    """Default kernel block (v5e measurements, docs/performance.md).
+
+    s <= 1024: ONE block covers the whole row — no interior k loop, the
+    diagonal-split tile is the entire score matrix; measured fastest
+    (round 4: fwd 0.94 -> 0.83 ms at gpt2-small shapes vs 512 blocks,
+    and `min(block, s)` keeps short rows unpadded). Beyond 1024 the
+    [bq, bk] f32 tiles exceed VMEM at block 1024 (the backward fails to
+    compile) and 512 measured up to ~20% (fwd) / ~34% (grad) faster per
+    row than 256; estimated time ~ padded_length / per-row-speed, so 256
+    wins only where its padding saving exceeds 512's ~1.2x per-row
+    advantage (s=1280: 1280 vs 1536/1.2 -> 256; s=2600: -> 512)."""
+    if s <= 1024:
+        return 1024
     if -(-s // 256) * 256 * 1.2 <= -(-s // 512) * 512:
         return 256
     return 512

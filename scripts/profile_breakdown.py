@@ -132,12 +132,16 @@ def parse(log_dir: str, n_steps: int) -> dict:
     if steps_line is not None and steps_line.events:
         step_s = (sum(ev.duration_ps for ev in steps_line.events)
                   / 1e12 / n_steps)
-    else:
+    elif ops_line.events:
         # no step markers (e.g. a trace without annotated steps): fall back
         # to the op-timeline span, which bounds the per-step device time
         lo = min(ev.offset_ps for ev in ops_line.events)
         hi = max(ev.offset_ps + ev.duration_ps for ev in ops_line.events)
         step_s = (hi - lo) / 1e12 / n_steps
+    else:
+        raise SystemExit(
+            f"the 'XLA Ops' line on plane {plane.name!r} has no events — "
+            "did the capture window miss the steps?")
 
     cats = collections.defaultdict(lambda: [0.0, 0.0, 0.0])  # t, flops, bytes
     tops = collections.Counter()

@@ -75,7 +75,11 @@ def main() -> int:
         print(f"=== shard {i + 1}/{len(batches)}: {' '.join(batch)}",
               flush=True)
         r = subprocess.run(cmd, cwd=REPO, env=env)
-        if r.returncode != 0:
+        if r.returncode == 5:
+            # pytest: no tests collected — normal for a shard when a -k
+            # filter matches nothing in its files, not a failure
+            print(f"=== shard {i + 1}: no tests matched", flush=True)
+        elif r.returncode != 0:
             desc = (f"signal {-r.returncode}" if r.returncode < 0
                     else f"exit {r.returncode}")
             failures.append((i + 1, desc))
