@@ -171,21 +171,30 @@ def run(num_iterations: int = 20) -> dict:
     rungs = [
         (gpt2_config("small", dtype="bfloat16", use_fused_xent=True,
                      tie_embeddings=True, unroll_layers=True),
-         16, "gpt2_small_seq1024_bs16"),
+         16, 4, "gpt2_small_seq1024_bs16"),
         (gpt2_config("medium", dtype="bfloat16", use_fused_xent=True,
                      tie_embeddings=True, unroll_layers=True),
-         8, "gpt2_medium_seq1024_bs8"),
+         8, 4, "gpt2_medium_seq1024_bs8"),
         # rung 4's model family (GQA + RoPE + SwiGLU + tied 128k vocab):
-        # bs4 is the largest that fits next to its own grads on one chip
+        # bs6 is the largest that fits next to its own grads on one chip
+        # (VERDICT r3 item 5 measurements, same unroll_layers lever on
+        # both sides: bs8 only fits WITH remat_layers and its 1.33x
+        # recompute FLOPs land it at ~15.3k tok/s — SLOWER than the
+        # stored-activation bs4/bs6 runs at ~18.9k, so more batch does
+        # not pay at a model already near the MXU roof; bs8-remat is
+        # reported below so the answer stays measured, not assumed)
         (llama_config("llama3.2-1b", dtype="bfloat16", use_fused_xent=True,
                       unroll_layers=True),
-         4, "llama32_1b_seq1024_bs4"),
+         6, 2, "llama32_1b_seq1024_bs6"),
+        (llama_config("llama3.2-1b", dtype="bfloat16", use_fused_xent=True,
+                      remat_layers=True, unroll_layers=True),
+         8, 4, "llama32_1b_seq1024_bs8_remat"),
     ]
-    for rung_cfg, batch, key in rungs:
+    for rung_cfg, batch, n_mb, key in rungs:
         if rung_cfg.n_layers % n_pipe == 0:
             try:
                 extra[key] = run_config(rung_cfg, batch, 1024,
-                                        num_iterations)
+                                        num_iterations, n_microbatches=n_mb)
             except Exception as e:  # pragma: no cover - hardware-dependent
                 extra[key] = {"error": str(e)}
         else:
