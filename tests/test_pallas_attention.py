@@ -198,3 +198,42 @@ def test_flash_unequal_blocks_multi_padded_kblocks(causal):
     for a, b_ in zip(gf, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("h,dh,block", [
+    (4, 64, 128),   # 2 heads/slab, block tiles 128 lanes
+    (2, 128, 128),  # hp=1 slab variant
+    (2, 64, 256),   # single-block row (block == s)
+])
+def test_flash_packed_head_path_matches_dense(h, dh, block):
+    """The head-packed (transpose-free) kernels (round 4): heads stay in
+    the lane dimension as 128-lane slabs (HP = 128//head_dim per grid
+    instance). Only TPU-lowerable shapes are admitted (the packed-lse
+    BlockSpec needs block_q % 128 == 0 or block_q == s — review finding),
+    so these configurations compile on the device, not just in interpret
+    mode. Forward and grads vs dense."""
+    b, s = 2, 256
+    ks = jax.random.split(jax.random.key(11), 4)
+    q = jax.random.normal(ks[0], (b, s, h, dh))
+    k = jax.random.normal(ks[1], (b, s, h, dh))
+    v = jax.random.normal(ks[2], (b, s, h, dh))
+    g = jax.random.normal(ks[3], (b, s, h, dh))
+    from distributed_training_with_pipeline_parallelism_tpu.ops.pallas_attention import (
+        _packed_ok)
+    assert _packed_ok(s, h, dh, True, None, block, block)
+    # sub-128 blocks must REJECT packing (Mosaic lowering would fail)
+    assert not _packed_ok(s, h, dh, True, None, 64, 64)
+    got = flash_attention(q, k, v, causal=True, block_q=block,
+                          block_k=block)
+    want = _full(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    gf = jax.grad(lambda q, k, v: jnp.vdot(
+        flash_attention(q, k, v, causal=True, block_q=block,
+                        block_k=block), g),
+        argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: jnp.vdot(_full(q, k, v, True), g),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-5, rtol=2e-5)
