@@ -119,6 +119,8 @@ def _embed_at(cfg: ModelConfig, embed: Pytree, tokens: jax.Array,
     from .transformer import embed_apply
     if cfg.arch == "gpt2":
         h = embedding_apply(embed["tok"], tokens)
+        if cfg.embed_scale:  # MoE-LM Gemma convention: scale precedes pos
+            h = h * (cfg.dim ** 0.5)
         pos = jax.lax.dynamic_slice_in_dim(embed["pos"], offset,
                                            tokens.shape[1])
         return h + pos

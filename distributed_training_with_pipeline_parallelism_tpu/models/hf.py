@@ -394,6 +394,12 @@ def to_hf(cfg: ModelConfig, params: Pytree):
     import transformers
 
     if cfg.arch == "gpt2":
+        if cfg.embed_scale:
+            raise NotImplementedError(
+                "embed_scale on gpt2 blocks (the MoE LM convention) has no "
+                "HF model_type — GPT2LMHeadModel never scales embeddings "
+                "and exporting without the scale would silently change the "
+                "logits")
         hf_cfg = transformers.GPT2Config(
             vocab_size=cfg.vocab_size, n_positions=cfg.max_seq_len,
             n_embd=cfg.dim, n_layer=cfg.n_layers, n_head=cfg.n_heads,

@@ -269,11 +269,13 @@ def moe_lm_logits_aux(cfg: ModelConfig, moe: MoEConfig, params: Dict,
     ``cfg.tie_embeddings`` the vocab matmul reuses the embedding table
     (round 4 — the pipeline executor's MoE stages share the same
     ``_stage_ce`` tied-head path)."""
-    if cfg.embed_scale:
-        raise NotImplementedError(
-            "embed_scale is not implemented for the MoE loss; mirror the "
-            "pipeline guard rather than silently skip the scaling")
     h = embedding_apply(params["embed"]["tok"], tokens)
+    if cfg.embed_scale:
+        # Gemma convention (models.transformer.embed_apply): embedding
+        # OUTPUTS scale by sqrt(dim) while the tied head keeps the
+        # unscaled table; scale before the positional rows so those stay
+        # unscaled too (matching seq_parallel.sp_embed_apply's order)
+        h = h * (cfg.dim ** 0.5)
     h = h + params["embed"]["pos"][: tokens.shape[1]]
     h = h.astype(jnp.dtype(cfg.dtype))
 

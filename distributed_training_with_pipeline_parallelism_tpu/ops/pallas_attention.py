@@ -714,8 +714,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if window is not None and (not causal or window < 1):
         raise ValueError("window requires causal attention and window >= 1")
     b, s, h, dh = q.shape
-    block_q = block_q or _auto_block(s)
-    block_k = block_k or _auto_block(s)
+    # Clamp to the sequence so short full-length rows (s <= 1024, where
+    # _auto_block returns 1024) still satisfy _packed_ok's s % block_q == 0
+    # and take the transpose-free packed path (block_q == s is an admissible
+    # packed-lse config under the Mosaic lane constraint).
+    block_q = min(block_q or _auto_block(s), s)
+    block_k = min(block_k or _auto_block(s), s)
     if _packed_ok(s, h, dh, causal, window, block_q, block_k):
         # transpose-free path: heads stay packed in the lane dimension
         # (see _flash_packed) — the [b,s,h,dh]->[b*h,s,dh] relayouts this

@@ -1430,7 +1430,10 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
         _check_moe_mesh(cfg, moe, T, n_seq, n_ep)
         if train_dropout:
             raise NotImplementedError(
-                "dropout is not plumbed through MoE stage bodies")
+                "the phase-stored/forward program does not plumb dropout "
+                "rng into MoE stage bodies (the tick executor does, via "
+                "moe_layer_apply's per-layer rng); use the tick executor "
+                "for MoE training with dropout")
         if fsdp:
             raise ValueError("fsdp eval composes with dense stages only")
     if fsdp and (n_data <= 1 or n_seq > 1):

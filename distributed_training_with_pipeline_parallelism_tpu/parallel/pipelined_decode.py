@@ -199,7 +199,9 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
             h_chan, tok_chan = ring((y, tok))
 
         # ------------------------------------------------------------------
-        # decode: lax.scan over M*(N-1) + D - 1 round-robin ticks
+        # decode: lax.scan over M*(N-1) + D round-robin ticks (the last
+        # tick does no compute — it exists only to bank the final
+        # stage-(D-1) -> 0 token arrival)
         # ------------------------------------------------------------------
         h1 = jnp.zeros((Bg, 1, cfg.dim), jnp.dtype(cfg.dtype))
 

@@ -33,7 +33,8 @@ NEG_INF = -1e30
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
                    causal: bool = False, dropout_rate: float = 0.0,
-                   dropout_rng: Optional[jax.Array] = None) -> jax.Array:
+                   dropout_rng: Optional[jax.Array] = None,
+                   window: Optional[int] = None) -> jax.Array:
     """Exact attention with sequence sharded over ``axis_name``.
 
     q, k, v: [batch, seq_local, heads, head_dim] (per-device shards; K/V head
@@ -52,7 +53,17 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
     which ring step processes the pair — the full [S, S] mask is a
     deterministic function of (rng, shard layout) that an unsharded oracle
     can reconstruct block by block (tests/test_ring_attention.py).
+
+    ``window`` (requires ``causal``) applies the Mistral sliding-window
+    band — query i attends keys in ``[i - window + 1, i]`` — via the same
+    global-coordinate block mask the causal case uses. Every ring step
+    still runs (the schedule is static under ``lax.scan``), so unlike the
+    Pallas kernel's block skipping this saves memory, not FLOPs; its
+    value is composition: windowed models whose sequences only fit
+    sharded.
     """
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal attention and window >= 1")
     D = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, s_q, h, dh = q.shape
@@ -71,7 +82,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
         if causal:
             iq = jnp.arange(s_q)[:, None] + my * s_q
             ik = jnp.arange(s_kv)[None, :] + src * s_kv
-            s = jnp.where((iq >= ik)[None, None], s, NEG_INF)
+            keep = iq >= ik
+            if window is not None:
+                keep = keep & (iq - ik < window)
+            s = jnp.where(keep[None, None], s, NEG_INF)
         m_blk = jnp.max(s, axis=-1)  # [b, h, s_q]
         m_new = jnp.maximum(m, m_blk)
         # guard fully-masked rows (exp(NEG_INF - NEG_INF) would be 1)
@@ -111,7 +125,8 @@ def ring_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
                    rope_angles: Optional[jax.Array] = None,
                    tp_axis: Optional[str] = None,
                    dropout_rate: float = 0.0,
-                   dropout_rng=None) -> jax.Array:
+                   dropout_rng=None,
+                   window: Optional[int] = None) -> jax.Array:
     """Sequence-parallel drop-in for ``ops.attention.mha_apply``: projections
     are local (they are position-wise), attention runs over the ring.
 
@@ -134,7 +149,8 @@ def ring_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
         dropout_rng = jax.random.fold_in(dropout_rng,
                                          jax.lax.axis_index(tp_axis))
     out = ring_attention(q, k, v, axis_name, causal=causal,
-                         dropout_rate=dropout_rate, dropout_rng=dropout_rng)
+                         dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+                         window=window)
     return tp_output_projection(params["o"], out.reshape(b, s, -1), tp_axis)
 
 

@@ -64,10 +64,6 @@ def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
         raise NotImplementedError(
             "tensor parallelism composes with ring attention only (Ulysses "
             "already shards heads over the seq axis)")
-    if cfg.sliding_window is not None:
-        raise NotImplementedError(
-            "sliding-window attention is not sequence-parallel yet; use the "
-            "dense pipeline/TP paths for Mistral-family models")
     sp_mha = ATTN_IMPLS[attn_impl]
     heads = cfg.n_heads // tp_size
     p = cfg.dropout if rng is not None else 0.0
@@ -110,7 +106,8 @@ def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
         a = rms_norm_apply(params["rms1"], h, cfg.rms_eps)
         attn = sp_mha(params["attn"], a, a, heads, axis_name,
                       causal=True, rope_angles=rope_angles, tp_axis=tp_axis,
-                      dropout_rate=p, dropout_rng=site(0))
+                      dropout_rate=p, dropout_rng=site(0),
+                      window=cfg.sliding_window)
         h = h + drop(attn, 1)
         m = _tp_in(rms_norm_apply(params["rms2"], h, cfg.rms_eps), tp_axis)
         act = jax.nn.silu if cfg.mlp_act == "silu" else jax.nn.gelu

@@ -37,7 +37,8 @@ from ..ops.layers import linear_apply
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str, causal: bool = False,
                       dropout_rate: float = 0.0,
-                      dropout_rng=None) -> jax.Array:
+                      dropout_rng=None,
+                      window: Optional[int] = None) -> jax.Array:
     """Exact attention with the sequence sharded over ``axis_name``.
 
     q, k, v: [batch, seq_local, heads, head_dim] per-device shards. Q heads
@@ -48,6 +49,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     query chunk's attention output, identical to unsharded attention up to
     float associativity.
     """
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal attention and window >= 1")
     D = jax.lax.psum(1, axis_name)
     h, h_kv = q.shape[2], k.shape[2]
     if h % D != 0:
@@ -63,8 +66,12 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     k, v = gqa_expand(k, v, q.shape[2])  # no-op if already expanded
     mask = None
     if causal:
+        # post-scatter each device holds the FULL sequence for its head
+        # block, so the (optionally windowed) band mask is the ordinary
+        # dense one — no global-coordinate bookkeeping needed
+        from ..ops.attention import band_mask
         s = q.shape[1]
-        mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None]
+        mask = band_mask(s, s, window)[None, None]
     # post-scatter the probs are [b, h/D, s, s] — a head-block shard of the
     # unsharded probs, so attention-prob dropout uses the same axis-aware
     # full-draw+slice masks as tensor parallelism (oracle-exact)
@@ -81,7 +88,8 @@ def ulysses_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
                       rope_angles: Optional[jax.Array] = None,
                       tp_axis: Optional[str] = None,
                       dropout_rate: float = 0.0,
-                      dropout_rng=None) -> jax.Array:
+                      dropout_rng=None,
+                      window: Optional[int] = None) -> jax.Array:
     """Sequence-parallel drop-in for ``ops.attention.mha_apply`` (same
     signature as :func:`..ring_attention.ring_mha_apply`): projections are
     position-wise (local); the attention core re-shards via all-to-all.
@@ -102,5 +110,5 @@ def ulysses_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
                           expand_gqa=False)  # expansion happens post-gather
     out = ulysses_attention(q, k, v, axis_name, causal=causal,
                             dropout_rate=dropout_rate,
-                            dropout_rng=dropout_rng)
+                            dropout_rng=dropout_rng, window=window)
     return linear_apply(params["o"], out.reshape(b, s, -1))

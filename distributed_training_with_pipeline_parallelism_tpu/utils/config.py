@@ -97,6 +97,8 @@ class ModelConfig:
     #   "gelu" (Gemma GeGLU, tanh approximation).
     # - embed_scale: multiply embedding OUTPUTS by sqrt(dim) (the tied head
     #   keeps the unscaled table, so this cannot fold into the weights).
+    #   Unlike the other two knobs, also allowed on arch='gpt2' so the MoE
+    #   LM (gpt2-style blocks) can use Gemma-style scaled embeddings.
     # Gemma's (1 + w) RMSNorm parametrization needs no knob: the +1 is
     # folded into the stored scale at HF import/export (models/hf.py).
     head_dim_override: Optional[int] = None
@@ -117,10 +119,15 @@ class ModelConfig:
         if self.mlp_act not in ("silu", "gelu"):
             raise ValueError(f"mlp_act={self.mlp_act!r} must be 'silu' or "
                              f"'gelu'")
-        if ((self.head_dim_override is not None or self.mlp_act != "silu"
-             or self.embed_scale) and self.arch != "llama"):
-            raise ValueError("head_dim_override / mlp_act / embed_scale are "
-                             "Gemma-family knobs on arch='llama' blocks")
+        if ((self.head_dim_override is not None or self.mlp_act != "silu")
+                and self.arch != "llama"):
+            raise ValueError("head_dim_override / mlp_act are Gemma-family "
+                             "knobs on arch='llama' blocks")
+        if self.embed_scale and self.arch == "ref_decoder":
+            raise ValueError("embed_scale applies to gpt2/llama blocks "
+                             "(Gemma-style scaled embeddings; gpt2 is "
+                             "allowed so the MoE LM — gpt2-style blocks — "
+                             "can use it)")
         if self.head_dim_override is not None and self.head_dim_override < 1:
             raise ValueError(f"head_dim_override={self.head_dim_override}")
         if self.sliding_window is not None:

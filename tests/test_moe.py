@@ -149,3 +149,26 @@ def test_moe_lm_gradients_reach_all_experts():
     assert (per_expert > 0).all(), per_expert
     # router receives gradient through the combine weights
     assert np.abs(np.asarray(grads["layers"]["moe"]["router"]["w"])).sum() > 0
+
+
+def test_moe_lm_embed_scale_matches_prescaled_table():
+    """embed_scale (Gemma convention on the MoE LM, VERDICT r4 item 8):
+    scaling embedding OUTPUTS by sqrt(dim) — before the positional rows —
+    equals running embed_scale=False with a pre-scaled token table (valid
+    oracle only untied: a tied head would scale the vocab matmul too)."""
+    base = dict(dim=16, n_layers=1, n_heads=2, vocab_size=32, ffn_dim=32,
+                max_seq_len=64, arch="gpt2", tie_embeddings=False)
+    cfg_s = ModelConfig(embed_scale=True, **base)
+    cfg_o = ModelConfig(embed_scale=False, **base)
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=4.0, ffn_dim=16)
+    params = moe_lm_init(jax.random.key(0), cfg_s, moe)
+    oracle = jax.tree.map(lambda x: x, params)
+    oracle["embed"] = dict(oracle["embed"])
+    oracle["embed"]["tok"] = oracle["embed"]["tok"] * (cfg_o.dim ** 0.5)
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0,
+                                cfg_s.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (4, 32), 0,
+                                 cfg_s.vocab_size)
+    got = moe_lm_loss(cfg_s, moe, params, tokens, targets)
+    want = moe_lm_loss(cfg_o, moe, oracle, tokens, targets)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-6)

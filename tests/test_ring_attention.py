@@ -217,3 +217,68 @@ def test_ring_dropout_differs_from_eval_and_is_differentiable():
         mesh, in_specs=(P(None, SEQ_AXIS),) * 3,
         out_specs=P(None, SEQ_AXIS))(x, x, x) ** 2))(q)
     assert bool(jnp.all(jnp.isfinite(g)))
+
+
+# ---------------------------------------------------------------------------
+# sliding-window x sequence parallelism (VERDICT r4 item 8)
+# ---------------------------------------------------------------------------
+
+
+def _banded_attention(q, k, v, window):
+    from distributed_training_with_pipeline_parallelism_tpu.ops.attention import (
+        band_mask)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = jnp.where(band_mask(q.shape[1], k.shape[1], window)[None, None],
+                  s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("window", [1, 5, 12, 32])
+def test_ring_attention_window_matches_banded(window):
+    """Window band crossing chunk boundaries (chunk=8 at D=4; window 5/12
+    straddle 1 and 2 ring hops; 1 = diagonal only; 32 = full causal)."""
+    D = 4
+    b, s, h, dh = 2, 32, 4, 16
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, dh))
+    k = jax.random.normal(ks[1], (b, s, h, dh))
+    v = jax.random.normal(ks[2], (b, s, h, dh))
+    ref = _banded_attention(q, k, v, window)
+
+    mesh = make_sp_mesh(D)
+    ring = _shard_map(
+        lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS, causal=True,
+                                       window=window),
+        mesh,
+        in_specs=(P(None, SEQ_AXIS),) * 3, out_specs=P(None, SEQ_AXIS))
+    got = ring(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("attn_impl", ["ring", "ulysses"])
+def test_sliding_window_seq_parallel_matches_dense(attn_impl):
+    """Mistral-family sliding window under both SP strategies: loss and
+    grads equal the dense windowed model (guard closed, VERDICT r4 item 8)."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=2, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=64, arch="llama",
+                           n_kv_heads=2, sliding_window=5)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (2, 32), 0,
+                                 cfg.vocab_size)
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.transformer_loss(cfg, p, tokens, targets))(params)
+
+    mesh = make_sp_mesh(4)
+    sp_loss_fn = make_sp_loss_fn(cfg, mesh, attn_impl=attn_impl)
+    loss, grads = jax.jit(jax.value_and_grad(
+        lambda p: sp_loss_fn(p, tokens, targets)))(params)
+
+    assert float(jnp.abs(loss - ref_loss)) < 1e-5
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                       grads, ref_grads)
+    assert max(jax.tree.leaves(err)) < 2e-5
