@@ -274,7 +274,7 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         # the elementwise optax init/update inherits that layout through
         # jit, so moments are born sharded with no extra machinery
         from ..parallel.pipeline import fsdp_shard_params
-        params = fsdp_shard_params(params, cfg, mesh)
+        params = fsdp_shard_params(params, cfg, mesh, moe=moe)
         opt_state = jax.jit(optimizer.init)(params)
     elif zero1:
         # init directly INTO the sharded layout: the replicated moments
