@@ -84,18 +84,27 @@ def _attend_cached(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
 def _layer_step(cfg: ModelConfig, lp: Pytree, h: jax.Array, k_cache: jax.Array,
                 v_cache: jax.Array, offset: jax.Array,
-                rope_slice: Optional[jax.Array]
+                rope_slice: Optional[jax.Array],
+                tp_axis: Optional[str] = None, tp_size: int = 1
                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One block over S new positions; writes their k/v into the cache at
-    ``offset`` and returns (h_out, k_cache, v_cache)."""
+    ``offset`` and returns (h_out, k_cache, v_cache).
+
+    ``tp_axis`` (round 5, inside shard_map only) runs the block
+    Megatron-sharded over that mesh axis: q/k/v column-parallel (local
+    head shards — the KV cache holds ``Hkv/tp_size`` heads per model
+    rank), o and the MLP down-projection row-parallel with one psum each.
+    Decode is where TP shines — small batch, weight-read bound — and the
+    weight reads split ``tp_size`` ways."""
     b, s, _ = h.shape
-    n_kv = cfg.n_kv_heads or cfg.n_heads
+    n_heads = cfg.n_heads // tp_size
+    n_kv = (cfg.n_kv_heads or cfg.n_heads) // tp_size
     if cfg.arch == "gpt2":
         a = layer_norm_apply(lp["ln1"], h)
     else:
         a = rms_norm_apply(lp["rms1"], h, cfg.rms_eps)
     ap = lp["attn"]
-    q = linear_apply(ap["q"], a).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    q = linear_apply(ap["q"], a).reshape(b, s, n_heads, cfg.head_dim)
     k = linear_apply(ap["k"], a).reshape(b, s, n_kv, cfg.head_dim)
     v = linear_apply(ap["v"], a).reshape(b, s, n_kv, cfg.head_dim)
     if rope_slice is not None:
@@ -105,10 +114,14 @@ def _layer_step(cfg: ModelConfig, lp: Pytree, h: jax.Array, k_cache: jax.Array,
                                            (0, offset, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
                                            (0, offset, 0, 0))
-    attn = linear_apply(ap["o"], _attend_cached(q, k_cache, v_cache, offset,
-                                                cfg.n_heads,
-                                                cfg.sliding_window))
-    return mlp_block(cfg, lp, h + attn), k_cache, v_cache
+    att = _attend_cached(q, k_cache, v_cache, offset, n_heads,
+                         cfg.sliding_window)
+    if tp_axis is None:
+        attn = linear_apply(ap["o"], att)
+    else:
+        from ..ops.collectives import tp_output_projection
+        attn = tp_output_projection(ap["o"], att, tp_axis)
+    return mlp_block(cfg, lp, h + attn, tp_axis=tp_axis), k_cache, v_cache
 
 
 def _embed_at(cfg: ModelConfig, embed: Pytree, tokens: jax.Array,
@@ -142,15 +155,18 @@ def rope_slice_at(cfg: ModelConfig, max_len: int, offset: jax.Array,
 
 def layers_with_cache(cfg: ModelConfig, layers: Pytree, h: jax.Array,
                       k_cache: jax.Array, v_cache: jax.Array,
-                      offset: jax.Array, rope_slice: Optional[jax.Array]
+                      offset: jax.Array, rope_slice: Optional[jax.Array],
+                      tp_axis: Optional[str] = None, tp_size: int = 1
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Scan a stack of blocks over S new positions with per-layer KV
-    caches [L, B, T, Hkv, hd]. Shared by the single-device decode and the
-    pipelined decode's stage bodies (each stage passes its layer slice and
-    cache shard)."""
+    caches [L, B, T, Hkv(/tp_size), hd]. Shared by the single-device
+    decode and the pipelined decode's stage bodies (each stage passes its
+    layer slice and cache shard; with ``tp_axis`` the layer leaves are
+    Megatron model-axis shards)."""
     def body(carry, xs):
         lp, kc, vc = xs
-        h, kc, vc = _layer_step(cfg, lp, carry, kc, vc, offset, rope_slice)
+        h, kc, vc = _layer_step(cfg, lp, carry, kc, vc, offset, rope_slice,
+                                tp_axis=tp_axis, tp_size=tp_size)
         return h, (kc, vc)
 
     return jax.lax.scan(body, h, (layers, k_cache, v_cache))

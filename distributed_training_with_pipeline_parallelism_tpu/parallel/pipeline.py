@@ -1377,13 +1377,13 @@ def fsdp_shard_params(params: Pytree, cfg: ModelConfig, mesh: Mesh,
         # in/out specs use
         dims = _moe_fsdp_shard_dims(cfg, moe, n_data, T, n_ep)
         base = _moe_template_specs(cfg, moe, T, n_ep)
-    elif T > 1:
-        from .tensor_parallel import _layer_specs
-        dims = _fsdp_shard_dims(cfg, n_data, T)
-        base = _layer_specs(cfg)
     else:
         dims = _fsdp_shard_dims(cfg, n_data, T)
-        base = jax.tree.map(lambda _: P(), dims)
+        if T > 1:
+            from .tensor_parallel import _layer_specs
+            base = _layer_specs(cfg)
+        else:
+            base = jax.tree.map(lambda _: P(), dims)
 
     def put_layer(x, spec, dm):
         # full-model layer leaves are [L, w0, ...]: 'pipe' on the layer

@@ -51,8 +51,9 @@ from ..models.generate import (_embed_at, init_cache, layers_with_cache,
                                rope_slice_at, sample_logits)
 from ..models.transformer import compute_cast, head_apply
 from ..utils.config import ModelConfig
-from .mesh import PIPE_AXIS
-from .pipeline import _shard_map, stack_stage_layers
+from .mesh import MODEL_AXIS, PIPE_AXIS
+from .pipeline import (_check_tp_divisibility, _dense_layer_specs,
+                       _shard_map, stack_stage_layers)
 
 
 def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
@@ -70,18 +71,29 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
     [B, P] with uniform length P and ``B`` divisible by ``n_streams``
     (default: the pipe degree D). Greedy when ``temperature == 0``;
     sampling knobs match :func:`..models.generate.sample_logits`.
+
+    A 'model' mesh axis (round 5) composes Megatron TP inside each
+    stage: layer weights are model-axis shards (the training executor's
+    stacked specs), each model rank caches only its kv-head shard, and
+    the o/down projections psum per layer — decode is weight-read bound
+    at small batch, so TP splits exactly the bandwidth that limits it.
+    The KV cache stays stage-sliced over 'pipe' as before. Seq/expert
+    axes remain unsupported here.
     """
     if cfg.arch not in ("gpt2", "llama"):
         raise ValueError(
             f"generation is undefined for arch {cfg.arch!r} (see "
             "models.generate)")
     D = mesh.shape[PIPE_AXIS]
+    T = mesh.shape.get(MODEL_AXIS, 1)
     for ax, n in mesh.shape.items():
-        if ax != PIPE_AXIS and n > 1:
+        if ax not in (PIPE_AXIS, MODEL_AXIS) and n > 1:
             raise NotImplementedError(
-                f"pipelined decode runs on a 1-D pipe mesh; axis {ax!r} "
-                f"has size {n} (use TP via models.generate, or batch "
-                "scoring via make_pipeline_forward)")
+                f"pipelined decode composes pipe x model meshes; axis "
+                f"{ax!r} has size {n} (batch scoring via "
+                "make_pipeline_forward supports the full mesh space)")
+    _check_tp_divisibility(cfg, T)
+    tp_axis = MODEL_AXIS if T > 1 else None
     if cfg.n_layers % D:
         raise ValueError(f"n_layers={cfg.n_layers} must divide over {D} "
                          "stages")
@@ -116,7 +128,8 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
                              f"exceeds the gpt2 position table "
                              f"(max_seq_len={cfg.max_seq_len})")
         lps = cfg.n_layers // D
-        n_kv = cfg.n_kv_heads or cfg.n_heads
+        # under TP each model rank caches only ITS kv-head shard
+        n_kv = (cfg.n_kv_heads or cfg.n_heads) // T
         kc = jnp.zeros((lps, B, mlen, n_kv, cfg.head_dim),
                        jnp.dtype(cfg.dtype))
         vc = kc
@@ -136,7 +149,8 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
             vg = jax.lax.dynamic_slice_in_dim(vc, g * Bg, Bg, axis=1)
             rope = rope_slice_at(cfg, kc.shape[2], offset, s)
             h, (kg, vg) = layers_with_cache(cfg, layers_d, h, kg, vg,
-                                            offset, rope)
+                                            offset, rope, tp_axis=tp_axis,
+                                            tp_size=T)
             kc = jax.lax.dynamic_update_slice_in_dim(kc, kg, g * Bg, axis=1)
             vc = jax.lax.dynamic_update_slice_in_dim(vc, vg, g * Bg, axis=1)
             return h, kc, vc
@@ -156,13 +170,46 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
         token_buf = jnp.zeros((M, Bg), jnp.int32)
         out_buf = jnp.zeros((N, M, Bg), jnp.int32)
 
+        vocab_parallel_head = (tp_axis is not None and not need_key
+                               and cfg.vocab_size % T == 0)
+
         def head_sample(y_last, g, e):
             """Last stage only: logits + sample; other stages skip the
-            vocab matmul entirely."""
+            vocab matmul entirely.
+
+            Greedy under TP goes vocab-parallel: each model rank reads
+            only its V/T column slice of the head weight (the O(dim*V)
+            head read is often the largest weight in a decode tick —
+            replicating it would cap the TP speedup well below T) and
+            the argmax merges via a [T, Bg] all_gather of per-shard
+            (max, argmax) pairs. First-max-wins on both levels
+            reproduces the global argmax tie-break (lowest index)
+            exactly. Sampling keeps the replicated head: top-k/top-p
+            need globally truncated logits."""
             def live():
-                logits = head_apply(cfg, head_c, y_last,
-                                    embed=embed_c)[:, 0]
-                return sample(g, e, logits).astype(jnp.int32)
+                if not vocab_parallel_head:
+                    logits = head_apply(cfg, head_c, y_last,
+                                        embed=embed_c)[:, 0]
+                    return sample(g, e, logits).astype(jnp.int32)
+                from ..models.transformer import head_norm_apply
+                t = jax.lax.axis_index(tp_axis)
+                Vl = cfg.vocab_size // T
+                hn = head_norm_apply(cfg, head_c, y_last)[:, 0]  # [Bg, dim]
+                if cfg.tie_embeddings:
+                    wsl = jax.lax.dynamic_slice_in_dim(
+                        embed_c["tok"], t * Vl, Vl, axis=0)  # [Vl, dim]
+                    logits_l = hn @ wsl.T
+                else:
+                    wsl = jax.lax.dynamic_slice_in_dim(
+                        head_c["out"]["w"], t * Vl, Vl, axis=1)
+                    logits_l = hn @ wsl  # gpt2/llama heads carry no bias
+                val = jnp.max(logits_l, axis=-1)
+                idx = jnp.argmax(logits_l, axis=-1) + t * Vl
+                vals = jax.lax.all_gather(val, tp_axis)  # [T, Bg]
+                idxs = jax.lax.all_gather(idx, tp_axis)
+                win = jnp.argmax(vals, axis=0)
+                return jnp.take_along_axis(idxs, win[None], axis=0)[0] \
+                    .astype(jnp.int32)
 
             return jax.lax.cond(d == D - 1, live,
                                 lambda: jnp.zeros((Bg,), jnp.int32))
@@ -252,9 +299,14 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
         # [N, M, Bg] -> [B, N]
         return jnp.moveaxis(out, 0, -1).reshape(B, N)
 
+    # layers: 'pipe' on the stage dim, plus Megatron 'model' dims when a
+    # model axis is present (same stacked-layout specs as the training
+    # executor, so a pp x tp-trained pytree decodes in-place)
+    layer_spec = (_dense_layer_specs(cfg, T, None) if T > 1
+                  else P(PIPE_AXIS))
     sharded = _shard_map(
         spmd, mesh,
-        in_specs=(P(PIPE_AXIS), P(), P(), P(), P()),
+        in_specs=(layer_spec, P(), P(), P(), P()),
         out_specs=P(),
     )
 

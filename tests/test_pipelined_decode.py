@@ -66,8 +66,47 @@ def test_pipelined_decode_sampling_and_errors():
         gen(params, prompt)  # sampling without a key
     with pytest.raises(ValueError, match="n_streams"):
         make_pipeline_generate_fn(cfg, make_mesh(n_pipe=2), 4, n_streams=1)
-    with pytest.raises(NotImplementedError, match="1-D pipe"):
+    with pytest.raises(NotImplementedError, match="pipe x model"):
         make_pipeline_generate_fn(cfg, make_mesh(n_pipe=2, n_data=2), 4)
     with pytest.raises(ValueError, match="position table"):
         make_pipeline_generate_fn(
             cfg, mesh, cfg.max_seq_len + 1)(params, prompt)
+
+
+@pytest.mark.parametrize("arch,kw", [
+    ("gpt2", {}),
+    ("llama", dict(n_kv_heads=2)),
+    # tied head: the vocab-parallel greedy argmax row-slices the
+    # embedding table instead of the head matrix
+    ("llama", dict(n_kv_heads=2, tie_embeddings=True)),
+])
+def test_pipelined_decode_tp_matches_single_device(arch, kw):
+    """pipe x model decode (round 5, VERDICT r4 item 7): Megatron TP
+    inside each stage — local kv-head cache shards, per-layer o/down
+    psums — still emits exactly the single-device greedy tokens."""
+    cfg = _cfg(arch, **kw)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    B, P, N = 4, 5, 6
+    prompt = jax.random.randint(jax.random.key(1), (B, P), 0,
+                                cfg.vocab_size)
+    want = generate(cfg, params, prompt, N)
+    gen = make_pipeline_generate_fn(cfg, make_mesh(n_pipe=2, n_model=2),
+                                    N, n_streams=2)
+    got = gen(params, prompt)
+    assert got.shape == (B, P + N)
+    assert (jnp.asarray(got) == jnp.asarray(want)).all(), (
+        got.tolist(), want.tolist())
+
+
+def test_pipelined_decode_tp_sampling_in_vocab():
+    cfg = _cfg("gpt2")  # 4 heads: n_kv divides the model-axis size 4
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (4, 4), 0,
+                                cfg.vocab_size)
+    gen = make_pipeline_generate_fn(cfg, make_mesh(n_pipe=2, n_model=4),
+                                    4, temperature=0.7, top_p=0.9,
+                                    n_streams=2)
+    toks = gen(params, prompt, key=jax.random.key(3))
+    assert toks.shape == (4, 8)
+    assert (jnp.asarray(toks) >= 0).all()
+    assert (jnp.asarray(toks) < cfg.vocab_size).all()
