@@ -401,9 +401,21 @@ def _check_moe_mesh(cfg: ModelConfig, moe, T: int, n_seq: int,
 
 # Auto-unroll threshold for the tick executor: tables at or below this many
 # tick rows compile as straight-line code (each row's units traced once
-# more), above it the lax.scan form keeps compile time bounded. ~32 rows
-# covers e.g. GPipe/1F1B to D=8 x M=12 and Interleaved V=2 to D=4 x M=8.
-_UNROLL_TICKS_LIMIT = 32
+# more), above it the lax.scan form keeps compile time bounded. Set from
+# round-5 v5e measurements (results/unroll_crossover.json, GPipe D=1 remat
+# executor, per-microbatch shapes fixed): unrolled beats scanned at EVERY
+# size measured — 1.19-1.20x through 32 rows, narrowing to ~1.05x at 48-64
+# rows — so there is no throughput crossover to encode; the binding cost is
+# compile time, which grows ~2.2 s/row (14 s at 8 rows -> 140 s at 64).
+# 64 rows covers every ladder config (Interleaved D=4/V=2/M=8 = 38 rows,
+# GPipe D=1 M=32 = 64) at <= ~2.5 min compile; beyond it the measured win
+# trend (shrinking) no longer justifies unbounded compile growth. Callers
+# iterating interactively can pass unroll_ticks=False for ~7 s compiles.
+_UNROLL_TICKS_LIMIT = 64
+# The FORWARD-only executor (make_pipeline_forward / eval) keeps the
+# round-4 budget: its per-row economics (forward ticks, no backward) were
+# not part of the round-5 measurement.
+_UNROLL_FWD_TICKS_LIMIT = 32
 
 
 def _concrete_know(col_vals):
@@ -1537,8 +1549,12 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
     table_np, n_slots = _fwd_tick_table(D, V, M)
     if unroll is None:
         # auto: D == 1 always unrolls (measured fastest); D > 1 up to the
-        # same tick-row budget as the training executor's unroll_ticks
-        unroll = D == 1 or table_np.shape[0] <= _UNROLL_TICKS_LIMIT
+        # forward executor's OWN row budget — round 5 raised the training
+        # executor's _UNROLL_TICKS_LIMIT to 64 from measurements of the
+        # train-step economics (results/unroll_crossover.json); forward
+        # ticks are ~1/3 of a train tick's compute, so the unroll win per
+        # compile-second is unmeasured here and the round-4 budget stays
+        unroll = D == 1 or table_np.shape[0] <= _UNROLL_FWD_TICKS_LIMIT
     table = jnp.asarray(table_np)
     dtype = jnp.dtype(cfg.dtype)
     fwd_perm = [(i, (i + 1) % D) for i in range(D)]

@@ -1,0 +1,143 @@
+"""Round-5 chip measurements (VERDICT r4 items 1 + 2, one session each).
+
+Part A — the one-session overhead triple: plain unrolled M=4 grad-accum
+loop, phase-stored executor (the bench headline form), rematerializing
+tick executor, and the fused full-batch ceiling, measured back-to-back in
+ONE session so the "executor is within a few % of the microbatching
+floor" claim stops resting on a cross-round comparison
+(docs/performance.md documents +-10% cross-session noise on this shared
+chip; within-session ratios are the only load-bearing numbers).
+
+Part B — the unroll-vs-scan crossover: the tick executor's straight-line
+(unrolled) form vs the lax.scan form at growing table sizes (GPipe D=1:
+the table is 2M rows, so M=24/32 exceed the round-4
+_UNROLL_TICKS_LIMIT=32 — the size class where the ladder's real configs
+live, e.g. Interleaved D=4/V=2/M=8 compiles 38 rows).
+Per (M, form): compile seconds (first call) and steady tokens/sec,
+per-microbatch shapes held fixed (mb=8 x seq 128) so the boundary cost
+per microbatch is the isolated variable.
+
+Writes results/unroll_crossover.json; docs/performance.md holds the
+analysis table.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.models import (
+    transformer as tfm)
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+    make_mesh)
+from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+    _compile, make_pipeline_step)
+
+from bench import _time_step  # median-of-3 windows, honest completion barrier
+
+CFG = dtpp.ModelConfig(dtype="bfloat16", use_fused_xent=True,
+                       max_seq_len=128)
+SEQ, MB = 128, 8  # per-microbatch batch rows, the reference's 32/4 split
+
+
+def _data(batch):
+    tokens = jax.random.randint(jax.random.key(1), (batch, SEQ), 0,
+                                CFG.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (batch, SEQ), 0,
+                                 CFG.vocab_size)
+    return tokens, targets
+
+
+def _measure(step, batch, iters):
+    tokens, targets = _data(batch)
+    t0 = time.perf_counter()
+    loss, _ = step(CFG_PARAMS, tokens, targets)
+    from distributed_training_with_pipeline_parallelism_tpu.utils.metrics import (
+        force_completion)
+    force_completion(loss)
+    compile_s = time.perf_counter() - t0
+    elapsed = _time_step(step, CFG_PARAMS, tokens, targets, iters)
+    return {"tokens_per_sec": round(batch * SEQ * iters / elapsed, 1),
+            "compile_s": round(compile_s, 2),
+            "elapsed_s": round(elapsed, 3)}
+
+
+def part_a(results):
+    """Overhead triple + ceiling, M=4 / batch 32."""
+    mesh = make_mesh(n_pipe=1)
+    sched = dtpp.ScheduleConfig(name="GPipe", n_microbatches=4)
+
+    def plain(params, tokens, targets):
+        # the honest hand-written comparator: 4 microbatches, summed
+        # grads scaled 1/4, straight-line (same semantics as the executor)
+        toks = tokens.reshape(4, MB, SEQ)
+        tgts = targets.reshape(4, MB, SEQ)
+
+        def mb_loss(p):
+            return sum(tfm.transformer_loss(CFG, p, toks[m], tgts[m])
+                       for m in range(4)) / 4.0
+
+        return jax.value_and_grad(mb_loss)(params)
+
+    forms = {
+        "plain_m4_loop": jax.jit(plain),
+        "phase_stored_executor": make_pipeline_step(
+            CFG, mesh, sched, force_tick_executor=True),
+        "tick_executor_remat": make_pipeline_step(
+            CFG, mesh, sched, force_tick_executor=True, remat_backward=True),
+        "fused_ceiling": make_pipeline_step(CFG, mesh, sched),
+    }
+    out = {}
+    for name, step in forms.items():
+        out[name] = _measure(step, 32, 20)
+        print(name, out[name], flush=True)
+    floor = out["plain_m4_loop"]["tokens_per_sec"]
+    for name in forms:
+        out[name]["vs_plain_loop"] = round(
+            floor / out[name]["tokens_per_sec"], 4)
+    results["overhead_triple"] = out
+
+
+def part_b(results):
+    """Unroll-vs-scan crossover, GPipe D=1 (table = 2M rows), remat tick executor."""
+    mesh = make_mesh(n_pipe=1)
+    rows = {}
+    for M in (4, 8, 16, 24, 32):
+        table_rows = _compile("GPipe", 1, 1, M).table.shape[0]
+        batch = MB * M
+        iters = max(5, 80 // M)
+        entry = {"table_rows": int(table_rows), "batch": batch}
+        for form, unroll in (("unrolled", True), ("scanned", False)):
+            sched = dtpp.ScheduleConfig(name="GPipe", n_microbatches=M)
+            step = make_pipeline_step(CFG, mesh, sched,
+                                      force_tick_executor=True,
+                                      remat_backward=True,
+                                      unroll_ticks=unroll)
+            entry[form] = _measure(step, batch, iters)
+            print(f"M={M} rows={table_rows} {form}: {entry[form]}",
+                  flush=True)
+        entry["unroll_speedup"] = round(
+            entry["unrolled"]["tokens_per_sec"]
+            / entry["scanned"]["tokens_per_sec"], 4)
+        rows[f"M{M}"] = entry
+    results["crossover"] = rows
+
+
+if __name__ == "__main__":
+    CFG_PARAMS = tfm.transformer_init(jax.random.key(0), CFG)
+    results = {"config": "ref_decoder L8/H8 dim768 vocab10k, bf16, "
+                         "fused-CE, seq 128, mb rows 8, v5e 1 chip",
+               "session": time.strftime("%Y-%m-%d %H:%M UTC",
+                                        time.gmtime())}
+    part_a(results)
+    part_b(results)
+    out_path = os.path.join(os.path.dirname(__file__), "..", "results",
+                            "unroll_crossover.json")
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps({"done": True}))
