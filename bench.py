@@ -174,10 +174,10 @@ def run(num_iterations: int = 20) -> dict:
         # bs16 53.9%, bs24 55.0%, bs32 54.8% MFU — bs32 only FITS since)
         (gpt2_config("small", dtype="bfloat16", use_fused_xent=True,
                      tie_embeddings=True, unroll_layers=True),
-         24, 4, "gpt2_small_seq1024_bs24"),
+         24, 4, 1024, "gpt2_small_seq1024_bs24"),
         (gpt2_config("medium", dtype="bfloat16", use_fused_xent=True,
                      tie_embeddings=True, unroll_layers=True),
-         8, 4, "gpt2_medium_seq1024_bs8"),
+         8, 4, 1024, "gpt2_medium_seq1024_bs8"),
         # rung 4's model family (GQA + RoPE + SwiGLU + tied 128k vocab):
         # bs6 is the largest that fits next to its own grads on one chip
         # (VERDICT r3 item 5 measurements, same unroll_layers lever on
@@ -188,15 +188,31 @@ def run(num_iterations: int = 20) -> dict:
         # reported below so the answer stays measured, not assumed)
         (llama_config("llama3.2-1b", dtype="bfloat16", use_fused_xent=True,
                       unroll_layers=True),
-         6, 2, "llama32_1b_seq1024_bs6"),
+         6, 2, 1024, "llama32_1b_seq1024_bs6"),
         (llama_config("llama3.2-1b", dtype="bfloat16", use_fused_xent=True,
                       remat_layers=True, unroll_layers=True),
-         8, 4, "llama32_1b_seq1024_bs8_remat"),
+         8, 4, 1024, "llama32_1b_seq1024_bs8_remat"),
     ]
-    for rung_cfg, batch, n_mb, key in rungs:
+    # Long-context rungs (round 5, VERDICT r4 item 5): the sequences where
+    # dense attention cannot even compile (8192: 18 GB of scores vs
+    # 15.75 GB HBM) — the flash kernels' clearest TPU-native win, now with
+    # committed numbers. Batch = the measured per-chip ceiling (4096: bs12
+    # OOMs; 8192: bs2 is the compile ceiling, docs/performance.md round-5
+    # long-context section). seq overrides the default 1024 below.
+    rungs += [
+        (gpt2_config("small", dtype="bfloat16", use_fused_xent=True,
+                     tie_embeddings=True, unroll_layers=True,
+                     max_seq_len=4096),
+         8, 1, 4096, "gpt2_small_seq4096_bs8"),
+        (gpt2_config("small", dtype="bfloat16", use_fused_xent=True,
+                     tie_embeddings=True, unroll_layers=True,
+                     max_seq_len=8192),
+         2, 1, 8192, "gpt2_small_seq8192_bs2"),
+    ]
+    for rung_cfg, batch, n_mb, seq, key in rungs:
         if rung_cfg.n_layers % n_pipe == 0:
             try:
-                extra[key] = run_config(rung_cfg, batch, 1024,
+                extra[key] = run_config(rung_cfg, batch, seq,
                                         num_iterations, n_microbatches=n_mb)
             except Exception as e:  # pragma: no cover - hardware-dependent
                 extra[key] = {"error": str(e)}

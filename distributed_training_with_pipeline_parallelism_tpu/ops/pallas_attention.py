@@ -428,10 +428,22 @@ def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, window):
 # ---------------------------------------------------------------------------
 
 
-def _packed_ok(s, h, dh, causal, window, block_q, block_k):
+# The packed kernels keep whole [s, h*dh] head-slabs resident in VMEM per
+# batch grid cell (q, k, v, o, do plus the f32 dq accumulator in the
+# backward). Measured cliff on v5e (round 5, h*dh = 768 bf16): the
+# backward compiles at s = 5120 (7.9 MB/slab) and fails at s = 6144
+# (9.4 MB/slab), so cap the slab at 8 MB and fall back to the classic
+# per-(batch, head) form — whose K/V residency is [s, dh], 1 MB at
+# s = 8192 — beyond it. The fallback pays the head transpose relayouts
+# (~10% at GPT-2 shapes) but compiles at any sequence length.
+_PACKED_SLAB_LIMIT_BYTES = 8 * 1024 * 1024
+
+
+def _packed_ok(s, h, dh, causal, window, block_q, block_k, itemsize=2):
     hp = 128 // dh if dh in (64, 128) else 0
     return (causal and window is None and hp > 0 and h % max(hp, 1) == 0
             and block_q == block_k and s % block_q == 0
+            and s * h * dh * itemsize <= _PACKED_SLAB_LIMIT_BYTES
             # Mosaic lowering constraint on the packed-lse BlockSpec
             # (1, 1, hp, block_q): its last block dim must tile 128 lanes
             # or span the whole array dim
@@ -678,9 +690,20 @@ def _auto_block(s: int) -> int:
     compile) and 512 measured up to ~20% (fwd) / ~34% (grad) faster per
     row than 256; estimated time ~ padded_length / per-row-speed, so 256
     wins only where its padding saving exceeds 512's ~1.2x per-row
-    advantage (s=1280: 1280 vs 1536/1.2 -> 256; s=2600: -> 512)."""
+    advantage (s=1280: 1280 vs 1536/1.2 -> 256; s=2600: -> 512).
+    Where the PADDED block-512 row length reaches 8192, 256 is forced:
+    COMPOSED train-step programs (flash backward custom-calls next to
+    the weight-grad dots) crash the v5e compiler at block 512 with
+    8192-long rows — the isolated kernel compiles at any block, the
+    failure needs the surrounding fusion, and block 256 compiles
+    (round-5 bisection; s=7168 with 512 is fine). The check uses the
+    padded length because the kernels pad ragged rows up to a block
+    multiple, so s=7700 would compile the same crash-prone 8192-row
+    block-512 shape. Per-row speed is secondary to compiling at all."""
     if s <= 1024:
         return 1024
+    if -(-s // 512) * 512 >= 8192:
+        return 256
     if -(-s // 256) * 256 * 1.2 <= -(-s // 512) * 512:
         return 256
     return 512
@@ -720,7 +743,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # packed-lse config under the Mosaic lane constraint).
     block_q = min(block_q or _auto_block(s), s)
     block_k = min(block_k or _auto_block(s), s)
-    if _packed_ok(s, h, dh, causal, window, block_q, block_k):
+    if _packed_ok(s, h, dh, causal, window, block_q, block_k,
+                  q.dtype.itemsize):
         # transpose-free path: heads stay packed in the lane dimension
         # (see _flash_packed) — the [b,s,h,dh]->[b*h,s,dh] relayouts this
         # call otherwise pays were ~10% of a GPT-2 train step

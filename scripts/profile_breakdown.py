@@ -61,12 +61,30 @@ def build_step(config: str):
         cfg = dtpp.ModelConfig(dtype="bfloat16", use_fused_xent=True,
                                max_seq_len=128)
         batch, seq = 32, 128
+    elif config == "llama-1b":
+        # the bench's flagship rung (llama32_1b_seq1024_bs6): GQA + RoPE +
+        # SwiGLU + tied 128k vocab, stored-activation backward
+        from distributed_training_with_pipeline_parallelism_tpu.models.llama import (
+            llama_config)
+        cfg = llama_config("llama3.2-1b", dtype="bfloat16",
+                           use_fused_xent=True, unroll_layers=True)
+        batch, seq = 6, 1024
+    elif config == "gpt2-small-8k":
+        # the long-context rung (gpt2_small_seq8192_bs2): flash kernels at
+        # a sequence where dense attention cannot compile
+        cfg = gpt2_config("small", dtype="bfloat16", use_fused_xent=True,
+                          tie_embeddings=True, unroll_layers=True,
+                          max_seq_len=8192)
+        batch, seq = 2, 8192
     else:
         size = config.split("-", 1)[1]
         cfg = gpt2_config(size, dtype="bfloat16", use_fused_xent=True,
                           tie_embeddings=True, unroll_layers=True)
         batch, seq = {"small": (16, 1024), "medium": (8, 1024)}[size]
-    sched = dtpp.ScheduleConfig(name="GPipe", n_microbatches=4)
+    # microbatch counts match the bench rungs (llama-1b: bs6/M=2;
+    # 8k: bs2/M=1 — the compile ceiling at that sequence)
+    n_mb = {"llama-1b": 2, "gpt2-small-8k": 1}.get(config, 4)
+    sched = dtpp.ScheduleConfig(name="GPipe", n_microbatches=n_mb)
     step = make_pipeline_step(cfg, make_mesh(n_pipe=1), sched)
     params = tfm.transformer_init(jax.random.key(0), cfg)
     tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
@@ -179,7 +197,8 @@ def parse(log_dir: str, n_steps: int) -> dict:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("config", choices=["ref", "gpt2-small", "gpt2-medium"])
+    ap.add_argument("config", choices=["ref", "gpt2-small", "gpt2-medium",
+                                       "llama-1b", "gpt2-small-8k"])
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--json", default=None, help="also write the result here")
     args = ap.parse_args()
