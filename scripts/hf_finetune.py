@@ -1,0 +1,270 @@
+"""HF-import fine-tune artifact (round 5, VERDICT r4 item 4).
+
+Proves two things IN ANGER that round 4's byte-level artifact did not:
+
+1. **The ``models/hf.py`` import path end-to-end**: a ``transformers``
+   ``GPT2LMHeadModel`` flows through ``from_hf`` into this framework's
+   (ModelConfig, params), trains, and exports back through ``to_hf``
+   with logits parity asserted.
+2. **The 50257-vocab BPE head/CE path trained for real**: the vocab
+   regime that dominates the MFU rungs (the byte-level run's vocab-256
+   head is a toy next to it), on a real BPE tokenization of real Python
+   source.
+
+Zero-egress constraint, stated honestly: this environment can download
+NOTHING, so no pretrained GPT-2 weights exist here (the HF cache is
+empty). The "pretrained" start is ``GPT2LMHeadModel(GPT2Config())`` at
+HF's own random init, saved with ``save_pretrained`` and reloaded from
+disk — exercising exactly the same import surface as downloaded weights
+(safetensors checkpoint -> transformers model -> ``from_hf``). The BPE
+tokenizer is likewise trained offline on the corpus with the
+``tokenizers`` library (GPT-2's own byte-level-BPE recipe) at GPT-2's
+50257 vocab size.
+
+Writes results/gpt2s_hf_ft/: loss.csv, eval.csv, samples.txt, README.md.
+Run on the real chip from anywhere: paths are repo-anchored.
+"""
+
+import csv
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, _REPO)
+
+OUT = os.path.join(_REPO, "results", "gpt2s_hf_ft")
+CORPUS_TRAIN = "/tmp/corpus_train.txt"
+CORPUS_EVAL = "/tmp/corpus_eval.txt"
+BIN_TRAIN = "/tmp/hf_ft_train.bin"
+BIN_EVAL = "/tmp/hf_ft_eval.bin"
+VOCAB = 50257  # GPT-2's own size: the head/CE regime the bench rungs use
+SEQ, BATCH, STEPS, MB = 1024, 16, 1500, 2
+
+
+def build_corpus():
+    """Round-4 corpus recipe: real Python source, 98/2 split."""
+    import glob
+    import sysconfig
+    if os.path.exists(CORPUS_TRAIN) and os.path.exists(CORPUS_EVAL):
+        return
+    roots = [sysconfig.get_paths()["stdlib"]]
+    for mod in ("numpy", "jax"):
+        try:
+            m = __import__(mod)
+            roots.append(os.path.dirname(m.__file__))
+        except ImportError:
+            pass
+    files = sorted(f for root in roots
+                   for f in glob.glob(os.path.join(root, "**", "*.py"),
+                                      recursive=True))
+    texts = []
+    for f in files:
+        try:
+            with open(f, encoding="utf-8") as fh:
+                texts.append(fh.read())
+        except (UnicodeDecodeError, OSError):
+            pass
+    blob = "\n".join(texts)
+    cut = int(len(blob) * 0.98)
+    with open(CORPUS_TRAIN, "w", encoding="utf-8") as f:
+        f.write(blob[:cut])
+    with open(CORPUS_EVAL, "w", encoding="utf-8") as f:
+        f.write(blob[cut:])
+
+
+def train_tokenizer():
+    """GPT-2-recipe byte-level BPE at vocab 50257, trained offline.
+
+    Returns (tokenizer, freshly_trained): a fresh tokenizer assigns new
+    ids, so the caller must invalidate any cached token bins — bins
+    encoded under an old tokenizer's ids would silently train garbage."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+    from tokenizers.trainers import BpeTrainer
+    tok_path = os.path.join(OUT, "tokenizer.json")
+    fresh = not os.path.exists(tok_path)
+    if not fresh:
+        t = Tokenizer.from_file(tok_path)
+    else:
+        t = Tokenizer(models.BPE())
+        t.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+        t.decoder = decoders.ByteLevel()
+        trainer = BpeTrainer(vocab_size=VOCAB, special_tokens=["<|endoftext|>"],
+                             initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+        t.train([CORPUS_TRAIN], trainer)
+        t.save(tok_path)
+    from transformers import PreTrainedTokenizerFast
+    return PreTrainedTokenizerFast(tokenizer_object=t,
+                                   eos_token="<|endoftext|>"), fresh
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    build_corpus()
+    tok, fresh_tokenizer = train_tokenizer()
+    print(f"tokenizer: {len(tok)} tokens", flush=True)
+
+    from distributed_training_with_pipeline_parallelism_tpu.utils.data import (
+        TokenFileDataset, encode_text_file_hf)
+    if fresh_tokenizer:  # new id assignments: cached bins are invalid
+        for p in (BIN_TRAIN, BIN_EVAL):
+            if os.path.exists(p):
+                os.remove(p)
+    if not os.path.exists(BIN_TRAIN):
+        n = encode_text_file_hf(CORPUS_TRAIN, BIN_TRAIN, tok)
+        print(f"train tokens: {n}", flush=True)
+    if not os.path.exists(BIN_EVAL):
+        n = encode_text_file_hf(CORPUS_EVAL, BIN_EVAL, tok)
+        print(f"eval tokens: {n}", flush=True)
+
+    # --- the import path in anger: HF model -> save -> reload -> from_hf
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import transformers
+
+    from distributed_training_with_pipeline_parallelism_tpu.models.hf import (
+        from_hf, to_hf)
+
+    hf_dir = "/tmp/hf_gpt2_random"
+    if not os.path.exists(hf_dir):
+        hf_cfg = transformers.GPT2Config(vocab_size=VOCAB)  # 124M layout
+        transformers.GPT2LMHeadModel(hf_cfg).save_pretrained(hf_dir)
+    hf_model = transformers.GPT2LMHeadModel.from_pretrained(hf_dir)
+    cfg, params = from_hf(hf_model, dtype="bfloat16")
+    cfg = dataclasses.replace(cfg, use_fused_xent=True, unroll_layers=True)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    print(f"imported: {cfg.arch} {n_params/1e6:.1f}M params, "
+          f"vocab {cfg.vocab_size}, tied={cfg.tie_embeddings}", flush=True)
+
+    import distributed_training_with_pipeline_parallelism_tpu as dtpp
+    from distributed_training_with_pipeline_parallelism_tpu.models import (
+        transformer as tfm)
+    from distributed_training_with_pipeline_parallelism_tpu.models.generate import (
+        generate)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+        make_mesh)
+    from distributed_training_with_pipeline_parallelism_tpu.utils import train
+
+    train_ds = TokenFileDataset(BIN_TRAIN, SEQ, seed=0)
+    loss_fn = jax.jit(lambda p, x, y: tfm.transformer_loss(cfg, p, x, y))
+
+    def eval_batches():
+        # a FRESH seeded dataset per eval pass: fit()'s eval_data contract
+        # (utils/train.py) requires the same held-out batches every time —
+        # a shared stateful RNG would score each eval on different crops
+        # and fold sampling noise into the published before/after delta
+        ds = TokenFileDataset(BIN_EVAL, SEQ, seed=1)
+        return map(lambda xy: (jnp.asarray(xy[0]), jnp.asarray(xy[1])),
+                   ds.batches(8))
+
+    def eval_loss(p, n_batches=8):
+        return train.evaluate(loss_fn, p, eval_batches(),
+                              n_batches)["eval_loss"]
+
+    before = eval_loss(params)
+    print(f"eval loss before: {before:.4f} (ln(50257)={np.log(VOCAB):.2f})",
+          flush=True)
+
+    mesh = make_mesh(n_pipe=1)
+    sched = dtpp.ScheduleConfig(name="GPipe", n_microbatches=MB)
+
+    def data_iter():
+        while True:
+            x, y = train_ds.sample(BATCH)
+            yield jnp.asarray(x), jnp.asarray(y)
+
+    t0 = time.time()
+    params, hist = train.fit(cfg, mesh, sched, params, data_iter(), STEPS,
+                             log_every=50, eval_data=eval_batches,
+                             eval_every=100, eval_batches=8)
+    wall = time.time() - t0
+    after = eval_loss(params)
+    toks = STEPS * BATCH * SEQ
+    print(f"eval loss after {STEPS} steps: {after:.4f} "
+          f"(ppl {np.exp(after):.1f} from {np.exp(before):.1f}); "
+          f"{toks/wall/1e3:.1f}k tok/s incl. optimizer", flush=True)
+
+    with open(os.path.join(OUT, "loss.csv"), "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["step", "train_loss"])
+        w.writerows(hist)
+    with open(os.path.join(OUT, "eval.csv"), "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["step", "eval_loss", "ppl"])
+        w.writerow([0, round(before, 4), round(float(np.exp(before)), 2)])
+        w.writerow([STEPS, round(after, 4), round(float(np.exp(after)), 2)])
+
+    # --- samples from the fine-tuned model
+    # per-prompt generation: prompts tokenize to different lengths and
+    # truncating to a common width would silently cut most of them.
+    # Temperature sampling: greedy decode from a briefly-trained model
+    # degenerates into token loops; the artifact should show the
+    # distribution, not argmax's fixed point.
+    prompts = ["def ", "import numpy", "class Model", "    return "]
+    with open(os.path.join(OUT, "samples.txt"), "w") as f:
+        for i, p in enumerate(prompts):
+            ids = jnp.asarray([tok(p)["input_ids"]], jnp.int32)
+            out = generate(cfg, params, ids, 48, key=jax.random.key(i),
+                           temperature=0.8, top_p=0.95)
+            f.write(tok.decode(list(np.asarray(out)[0]))
+                    + "\n" + "-" * 60 + "\n")
+
+    # --- export round trip: logits parity between framework and HF
+    import torch
+    hf_out = to_hf(dataclasses.replace(cfg, dtype="float32"),
+                   jax.tree.map(lambda x: x.astype(jnp.float32), params))
+    x = np.asarray(train_ds.sample(2)[0][:, :64])
+    with torch.no_grad():
+        hf_logits = hf_out(torch.from_numpy(x.astype(np.int64))).logits.numpy()
+    f32_cfg = dataclasses.replace(cfg, dtype="float32",
+                                  use_flash_attention=False)
+    ours = np.asarray(tfm.transformer_apply(
+        f32_cfg, jax.tree.map(lambda p: p.astype(jnp.float32), params),
+        jnp.asarray(x)))
+    err = float(np.max(np.abs(ours - hf_logits)))
+    scale = float(np.max(np.abs(hf_logits)))
+    print(f"export parity: max |logit diff| = {err:.4f} "
+          f"(max |logit| = {scale:.1f})", flush=True)
+    # Scale-aware: trained logits grow with training (measured |max| ~20
+    # at 500 steps, larger at 1500), and cross-runtime reassociation (XLA
+    # vs torch matmul order, tanh-gelu impls) lands ~1e-3 RELATIVE on a
+    # healthy export; a wrong weight layout produces O(1) relative error.
+    assert err < 5e-3 * max(scale, 1.0), (
+        f"export parity broken: max |logit diff| {err} vs scale {scale}")
+
+    with open(os.path.join(OUT, "README.md"), "w") as f:
+        f.write(f"""# HF-import fine-tune artifact (round 5)
+
+`scripts/hf_finetune.py`, one v5e chip. The `models/hf.py` import path
+exercised in anger at the 50257-vocab BPE regime (VERDICT r4 item 4):
+
+- **Import**: `GPT2LMHeadModel` (124M layout, vocab {VOCAB}) loaded from a
+  `save_pretrained` checkpoint and converted via `from_hf` — the same
+  surface downloaded weights use. Zero-egress honesty: no pretrained
+  weights exist in this environment (empty HF cache), so the start is
+  HF's own random init; the import path, the BPE data pipeline
+  (`encode_text_file_hf`), and the 50257-vocab head/CE training are the
+  demonstrated capabilities, not transfer learning.
+- **Tokenizer**: byte-level BPE (GPT-2 recipe) trained offline with the
+  `tokenizers` library on the corpus, vocab {VOCAB}
+  (`tokenizer.json` committed here).
+- **Data**: the round-4 corpus of real Python source (~23 MB, 98/2
+  split), BPE-encoded to ~{os.path.getsize(BIN_TRAIN)//2//1_000_000}M tokens.
+- **Run**: {STEPS} steps, batch {BATCH} x seq {SEQ}, bf16, fused-CE +
+  flash kernels, AdamW + clip + cosine via `utils/train.py:fit`.
+- **Result**: eval loss {before:.3f} -> {after:.3f}
+  (ppl {float(np.exp(before)):.1f} -> {float(np.exp(after)):.1f}),
+  {toks/wall/1e3:.0f}k tok/s incl. optimizer; `samples.txt` decoded with
+  the trained tokenizer.
+- **Export**: `to_hf` round trip with max |logit diff| = {err:.4f}
+  (f32, dense attention) vs the exported `transformers` model.
+""")
+    print("artifact written to", OUT, flush=True)
+
+
+if __name__ == "__main__":
+    main()
