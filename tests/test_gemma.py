@@ -113,8 +113,11 @@ def test_gemma_registry_and_guards():
     cfg = llama_config("gemma-2b")
     assert cfg.head_dim == 256 and cfg.n_kv_heads == 1  # multi-query
     assert cfg.mlp_act == "gelu" and cfg.embed_scale and cfg.tie_embeddings
-    with pytest.raises(ValueError, match="Gemma-family"):
+    # round 5: embed_scale is allowed on gpt2 too (MoE LM), so only the
+    # ref_decoder arch still rejects it — with its own message
+    with pytest.raises(ValueError, match="gpt2/llama"):
         dtpp.ModelConfig(embed_scale=True)  # ref_decoder arch
+    assert dtpp.ModelConfig(arch="gpt2", embed_scale=True).embed_scale
     with pytest.raises(ValueError, match="mlp_act"):
         dtpp.ModelConfig(arch="llama", mlp_act="relu")
 
