@@ -43,8 +43,10 @@ def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
     """Sequence-sharded twin of ``models.transformer.layer_apply``.
 
     With ``tp_axis`` the block is additionally Megatron tensor-parallel
-    (ring attention only): weight leaves are local model-axis shards, norms
-    replicated — the 4-D ``data x pipe x model x seq`` composition.
+    (ring or, since round 5, Ulysses attention): weight leaves are local
+    model-axis shards, norms replicated — the 4-D
+    ``data x pipe x model x seq`` composition. Under Ulysses the local
+    head shard must further divide by the seq-axis size.
 
     ``rng`` (train mode) enables dropout at the same sites (and with the
     same per-site streams) as the dense ``layer_apply``: residual and
@@ -60,10 +62,6 @@ def sp_layer_apply(cfg: ModelConfig, params, h: jax.Array, axis_name: str,
     from ..models.transformer import _ffn_out, _tp_in
     from ..ops.layers import sharded_dropout_apply
 
-    if tp_axis is not None and attn_impl != "ring":
-        raise NotImplementedError(
-            "tensor parallelism composes with ring attention only (Ulysses "
-            "already shards heads over the seq axis)")
     sp_mha = ATTN_IMPLS[attn_impl]
     heads = cfg.n_heads // tp_size
     p = cfg.dropout if rng is not None else 0.0

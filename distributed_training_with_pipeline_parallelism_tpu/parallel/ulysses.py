@@ -94,21 +94,31 @@ def ulysses_mha_apply(params: Dict, q_in: jax.Array, kv_in: jax.Array,
     signature as :func:`..ring_attention.ring_mha_apply`): projections are
     position-wise (local); the attention core re-shards via all-to-all.
 
-    ``tp_axis`` is accepted for signature parity with ``ring_mha_apply``
-    but tensor parallelism does not compose with Ulysses (heads are already
-    sharded over the seq axis) — callers must pass None.
+    ``tp_axis`` (round 5) additionally Megatron-shards the projections
+    over that mesh axis — ``n_heads`` is then the LOCAL head count
+    (``H / tp_size``, weight leaves local model-axis shards) and the two
+    shardings nest: each model column all-to-alls its own head shard over
+    'seq', so post-scatter a device owns the full sequence for
+    ``H / (tp_size * seq_size)`` heads (requires the local head count to
+    divide by the seq-axis size), and the o-projection completes
+    row-parallel with one psum. Attention-prob dropout under TP folds the
+    model-axis rank into the rng (each model rank holds a DIFFERENT head
+    shard — the ring path's rule), so the realized mask layout is a
+    function of the TP degree rather than the unsharded oracle's.
 
     ``rope_angles`` must be pre-sliced to this device's global positions
     (``ring_attention.local_rope_angles``) — rotation happens *before* the
     head-scatter, while rows still sit at their global positions.
     """
-    if tp_axis is not None:
-        raise NotImplementedError(
-            "tensor parallelism does not compose with Ulysses attention")
+    from ..ops.collectives import tp_attention_inputs, tp_output_projection
     b, s, _ = q_in.shape
+    q_in, kv_in = tp_attention_inputs(q_in, kv_in, tp_axis)
     q, k, v = qkv_project(params, q_in, kv_in, n_heads, rope_angles,
                           expand_gqa=False)  # expansion happens post-gather
+    if dropout_rng is not None and tp_axis is not None:
+        dropout_rng = jax.random.fold_in(dropout_rng,
+                                         jax.lax.axis_index(tp_axis))
     out = ulysses_attention(q, k, v, axis_name, causal=causal,
                             dropout_rate=dropout_rate,
                             dropout_rng=dropout_rng, window=window)
-    return linear_apply(params["o"], out.reshape(b, s, -1))
+    return tp_output_projection(params["o"], out.reshape(b, s, -1), tp_axis)

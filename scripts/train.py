@@ -157,9 +157,9 @@ def main():
         ap.error("--moe-experts composes with --pipe/--data/--ep only")
     if args.moe_experts and not args.model.startswith("gpt2-"):
         ap.error("--moe-experts uses gpt2-style blocks; pick a gpt2-* model")
-    if args.sp_attn == "ulysses" and args.sp > 1 and args.tp > 1:
-        ap.error("--sp-attn ulysses does not compose with --tp "
-                 "(TP composes with ring attention only)")
+    # --sp-attn ulysses composes with --tp since round 5 (the Megatron
+    # head shard all-to-alls over 'seq' within each model column); the
+    # library validates head-count divisibility
     if args.vocab_parallel and args.tp <= 1:
         ap.error("--vocab-parallel requires --tp > 1")
     if args.auto_resume and not args.ckpt:

@@ -233,3 +233,40 @@ def test_train_step_with_dropout_smoke():
                           dtpp.ScheduleConfig(name="GPipe", n_microbatches=2),
                           params, data, num_steps=3, verbose=False)
     assert all(jnp.isfinite(loss) for _, loss in history)
+
+
+def test_pipeline_dropout_with_ulysses_tp():
+    """dropout x (TP x Ulysses SP) — round-5 composition: the model-axis
+    rank folds into the attention-prob rng (each model rank holds a
+    DIFFERENT head shard; ulysses_mha_apply's TP branch), so the mask
+    layout is a function of the TP degree — no unsharded-oracle equality
+    to assert. What is asserted: the composition trains (finite loss and
+    grads), train mode differs from eval, and the per-microbatch streams
+    thread through the executor (microbatch permutation moves the loss,
+    the ring test's canary)."""
+    import numpy as np
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=50,
+                           ffn_dim=64, dropout=0.25, arch="gpt2",
+                           max_seq_len=16)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0,
+                                cfg.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (4, 16), 0,
+                                 cfg.vocab_size)
+    rng = jax.random.key(9)
+    sched = dtpp.ScheduleConfig(name="GPipe", n_microbatches=2)
+    mesh = make_mesh(n_pipe=2, n_model=2, n_seq=2)
+    step = make_pipeline_step(cfg, mesh, sched, sp_attn_impl="ulysses")
+    loss, grads = jax.device_get(step(params, tokens, targets, rng))
+    assert np.isfinite(loss)
+    assert all(np.all(np.isfinite(g)) for g in jax.tree.leaves(grads))
+    perm_tokens = jnp.concatenate([tokens[2:], tokens[:2]])
+    perm_targets = jnp.concatenate([targets[2:], targets[:2]])
+    loss_perm = jax.device_get(step(params, perm_tokens, perm_targets,
+                                    rng)[0])
+    assert abs(loss_perm - loss) > 1e-6
+    eval_cfg = dataclasses.replace(cfg, dropout=0.0)
+    eval_step = make_pipeline_step(eval_cfg, mesh, sched,
+                                   sp_attn_impl="ulysses")
+    eval_loss = jax.device_get(eval_step(params, tokens, targets)[0])
+    assert abs(eval_loss - loss) > 1e-4  # dropout actually engaged

@@ -118,12 +118,35 @@ def test_4d_dp_pp_tp_sp():
     _check(step, *prob)
 
 
-def test_tp_sp_rejected_for_ulysses():
-    from distributed_training_with_pipeline_parallelism_tpu.parallel.ulysses import (
-        ulysses_mha_apply)
-    with pytest.raises(NotImplementedError, match="Ulysses"):
-        ulysses_mha_apply({}, jnp.zeros((1, 4, 8)), jnp.zeros((1, 4, 8)),
-                          2, "seq", tp_axis="model")
+def test_tp_sp_ulysses_composes():
+    """Round-5 guard closure: Megatron TP nests with Ulysses — each model
+    column all-to-alls its own head shard over 'seq' (4 heads / T=2 / D=2
+    -> 1 head per device post-scatter), the o projection completes
+    row-parallel. Loss/grads equal single-device autodiff."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=32, arch="gpt2")
+    prob = _problem(cfg)
+    mesh = make_mesh(n_pipe=2, n_model=2, n_seq=2)
+    step = make_pipeline_step(cfg, mesh,
+                              dtpp.ScheduleConfig(name="GPipe",
+                                                  n_microbatches=2),
+                              sp_attn_impl="ulysses")
+    _check(step, *prob)
+
+
+def test_4d_dp_free_pp_tp_sp_ulysses_llama():
+    """The 4-D llama composition on the Ulysses transport (GQA: 8 q heads
+    / 4 kv heads, both dividing T*D = 4)."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=8, n_kv_heads=4,
+                           vocab_size=64, ffn_dim=64, max_seq_len=32,
+                           arch="llama")
+    prob = _problem(cfg)
+    mesh = make_mesh(n_pipe=2, n_model=2, n_seq=2)
+    step = make_pipeline_step(cfg, mesh,
+                              dtpp.ScheduleConfig(name="1F1B",
+                                                  n_microbatches=2),
+                              sp_attn_impl="ulysses")
+    _check(step, *prob)
 
 
 @pytest.mark.parametrize("arch,kw", [
