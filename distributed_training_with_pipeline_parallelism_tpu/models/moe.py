@@ -209,12 +209,23 @@ def moe_layer_apply(cfg: ModelConfig, moe: MoEConfig, params: Dict,
                     tp_axis: Optional[str] = None,
                     tp_size: int = 1,
                     rng: Optional[jax.Array] = None,
+                    sp_axis: Optional[str] = None,
+                    sp_attn_impl: str = "ring",
                     ) -> Tuple[jax.Array, jax.Array]:
     """One MoE decoder block. ``axis_name`` shards experts (EP);
     ``tp_axis``/``tp_size`` additionally Megatron-shards the attention
     heads and each expert's ffn dim over the model axis — EP moves whole
     experts across devices, TP splits every expert's matmuls, and the two
     compose (each expert shard group runs its ffn slice).
+
+    ``sp_axis`` (round 5) runs the block with the SEQUENCE sharded over
+    that mesh axis: attention goes through the ring/Ulysses transport
+    (``sp_attn_impl``) exactly as dense seq-parallel stages do, while the
+    MoE FFN — position-wise by construction — routes each shard's LOCAL
+    tokens with local capacity, the same local-routing semantics the EP
+    path already uses for its batch sharding (capacity is computed from
+    the local token count, so routing statistics are per-shard). No new
+    collective: the expert all_to_all stays on the expert axis.
 
     ``rng`` (train mode, round 4) enables dropout at the dense gpt2
     block's sites: attention probabilities (stream 0), the attention
@@ -224,7 +235,9 @@ def moe_layer_apply(cfg: ModelConfig, moe: MoEConfig, params: Dict,
     by construction (no per-expert-slot mask streams needed) and follows
     the same (key, shard, microbatch, layer, site) convention as the
     dense executor (tests/test_moe_pipeline.py asserts the partition
-    invariance)."""
+    invariance). Dropout with ``sp_axis`` is rejected upstream (the
+    residual/FFN masks would need seq-sharded slicing — see
+    ``_check_moe_mesh``)."""
     from ..ops.layers import dropout_apply
     p = cfg.dropout if rng is not None else 0.0
 
@@ -232,9 +245,16 @@ def moe_layer_apply(cfg: ModelConfig, moe: MoEConfig, params: Dict,
         return None if rng is None else jax.random.fold_in(rng, i)
 
     a = layer_norm_apply(params["ln1"], h)
-    attn = mha_apply(params["attn"], a, a, cfg.n_heads // tp_size,
-                     causal=True, tp_axis=tp_axis, tp_size=tp_size,
-                     dropout_rate=p, dropout_rng=site(0))
+    if sp_axis is not None:
+        from ..parallel.seq_parallel import ATTN_IMPLS
+        attn = ATTN_IMPLS[sp_attn_impl](
+            params["attn"], a, a, cfg.n_heads // tp_size, sp_axis,
+            causal=True, tp_axis=tp_axis, dropout_rate=p,
+            dropout_rng=site(0))
+    else:
+        attn = mha_apply(params["attn"], a, a, cfg.n_heads // tp_size,
+                         causal=True, tp_axis=tp_axis, tp_size=tp_size,
+                         dropout_rate=p, dropout_rng=site(0))
     h = h + dropout_apply(attn, p, site(1))
     m = layer_norm_apply(params["ln2"], h)
     y, aux = moe_ffn_apply(params["moe"], m, moe, axis_name, tp_axis)

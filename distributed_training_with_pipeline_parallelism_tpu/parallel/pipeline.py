@@ -382,11 +382,20 @@ def _moe_fsdp_shard_dims(cfg: ModelConfig, moe, n_data: int, T: int,
 def _check_moe_mesh(cfg: ModelConfig, moe, T: int, n_seq: int,
                     n_ep: int) -> None:
     """The MoE mesh-composition contract, shared by the training executor
-    and the forward-only eval program (raise identically on both)."""
-    if n_seq > 1:
+    and the forward-only eval program (raise identically on both).
+
+    A seq axis composes since round 5: attention rides the ring/Ulysses
+    transport while the (position-wise) MoE FFN routes each shard's
+    local tokens with local capacity — the EP path's local-routing
+    semantics applied to the sequence dimension. Only dropout is still
+    excluded there (the residual/FFN masks would need the seq-sharded
+    slicing the dense sp path uses)."""
+    if n_seq > 1 and cfg.dropout > 0.0:
         raise NotImplementedError(
-            "MoE pipeline composes with data/pipe/expert/model axes; "
-            "the seq axis is not supported with MoE stages")
+            "MoE x seq with train-mode dropout: the residual/FFN masks "
+            "are not plumbed through seq-sharded MoE stage bodies yet "
+            "(dense seq stages and unsharded-seq MoE both support "
+            "dropout)")
     if cfg.arch != "gpt2":
         raise ValueError("MoE pipeline blocks are gpt2-style; set "
                          "arch='gpt2'")
@@ -714,7 +723,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                              else jax.random.fold_in(rng_mb, offset + i))
                     h, a = moe_layer_apply(cfg, moe, lp, h, ep_axis,
                                            tp_axis=tp_axis, tp_size=T,
-                                           rng=rng_l)
+                                           rng=rng_l, sp_axis=sp_axis,
+                                           sp_attn_impl=sp_attn_impl)
                     return (h, aux + a), None
 
                 if cfg.remat_layers:
@@ -1284,7 +1294,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     else:
         layer_spec = P(PIPE_AXIS)
     if n_seq > 1:
-        batch_spec = P(DATA_AXIS, SEQ_AXIS)
+        # with an expert axis too (MoE x seq, round 5) the batch shards
+        # over data x expert while the sequence shards over seq
+        lead = (DATA_AXIS, EXPERT_AXIS) if n_ep > 1 else DATA_AXIS
+        batch_spec = P(lead, SEQ_AXIS)
     elif n_ep > 1:
         batch_spec = P((DATA_AXIS, EXPERT_AXIS))  # batch over data x expert
     else:
@@ -1606,7 +1619,9 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
                 def mstep(h, lp):
                     # aux dropped: eval reports CE only (module docstring)
                     h, _aux = moe_layer_apply(cfg, moe, lp, h, ep_axis,
-                                              tp_axis=tp_axis, tp_size=T)
+                                              tp_axis=tp_axis, tp_size=T,
+                                              sp_axis=sp_axis,
+                                              sp_attn_impl=sp_attn_impl)
                     return h, None
 
                 y, _ = jax.lax.scan(mstep, x, layer_p)
@@ -1755,7 +1770,10 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
     else:
         head_spec = P()
     if n_seq > 1:
-        batch_spec = P(DATA_AXIS, SEQ_AXIS)
+        # with an expert axis too (MoE x seq, round 5) the batch shards
+        # over data x expert while the sequence shards over seq
+        lead = (DATA_AXIS, EXPERT_AXIS) if n_ep > 1 else DATA_AXIS
+        batch_spec = P(lead, SEQ_AXIS)
     elif n_ep > 1:
         batch_spec = P((DATA_AXIS, EXPERT_AXIS))  # batch over data x expert
     else:

@@ -376,3 +376,69 @@ def test_moe_pipeline_fsdp_tp():
     err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
                        grads, ref_grads)
     assert max(jax.tree.leaves(err)) < 2e-5
+
+
+# ---------------------------------------------------------------------------
+# MoE x seq (round 5): sequence-sharded MoE stages
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("attn_impl", ["ring", "ulysses"])
+def test_moe_pipeline_seq_parallel(attn_impl):
+    """pp x sp with MoE stages: attention rides the ring/Ulysses
+    transport while the position-wise MoE FFN routes each seq shard's
+    LOCAL tokens with local capacity. With zero-drop capacity every
+    token reaches its top-k experts with its own gates, so the CE equals
+    the unsharded oracle exactly (aux off: routing stats are per-shard,
+    the EP batch-sharding convention applied to the sequence)."""
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=4.0,
+                    aux_loss_weight=0.0)
+    prob = _problem(moe, M=2)
+    mesh = make_mesh(n_pipe=2, n_seq=2)
+    step = make_pipeline_step(CFG, mesh,
+                              dtpp.ScheduleConfig(name="GPipe",
+                                                  n_microbatches=2),
+                              moe=moe, sp_attn_impl=attn_impl)
+    _check(step, *prob)
+
+
+def test_moe_pipeline_seq_expert():
+    """The full MoE mesh: pipe x seq x expert — local routing per seq
+    shard, expert all_to_all on the expert axis, batch sharded over
+    data x expert while the sequence shards over seq."""
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=4.0,
+                    aux_loss_weight=0.0)
+    prob = _problem(moe, M=2)
+    mesh = make_mesh(n_pipe=2, n_seq=2, n_expert=2)
+    step = make_pipeline_step(CFG, mesh,
+                              dtpp.ScheduleConfig(name="1F1B",
+                                                  n_microbatches=2),
+                              moe=moe)
+    _check(step, *prob)
+
+
+def test_moe_seq_dropout_still_guarded():
+    import dataclasses as dc
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=4.0)
+    cfg = dc.replace(CFG, dropout=0.1)
+    with pytest.raises(NotImplementedError, match="dropout"):
+        make_pipeline_step(cfg, make_mesh(n_pipe=2, n_seq=2),
+                           dtpp.ScheduleConfig(name="GPipe",
+                                               n_microbatches=2),
+                           moe=moe)
+
+
+def test_moe_pipeline_tp_seq():
+    """pipe x model x seq with MoE stages: the seq transport carries the
+    Megatron head shard (ring path) while each expert's matmuls stay
+    model-split — exact vs the microbatched oracle (zero drops, aux
+    off)."""
+    moe = MoEConfig(n_experts=4, top_k=2, capacity_factor=4.0,
+                    aux_loss_weight=0.0)
+    prob = _problem(moe, M=2)
+    mesh = make_mesh(n_pipe=2, n_model=2, n_seq=2)
+    step = make_pipeline_step(CFG, mesh,
+                              dtpp.ScheduleConfig(name="GPipe",
+                                                  n_microbatches=2),
+                              moe=moe)
+    _check(step, *prob)
