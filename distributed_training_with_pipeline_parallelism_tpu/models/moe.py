@@ -211,6 +211,7 @@ def moe_layer_apply(cfg: ModelConfig, moe: MoEConfig, params: Dict,
                     rng: Optional[jax.Array] = None,
                     sp_axis: Optional[str] = None,
                     sp_attn_impl: str = "ring",
+                    sp_size: int = 1,
                     ) -> Tuple[jax.Array, jax.Array]:
     """One MoE decoder block. ``axis_name`` shards experts (EP);
     ``tp_axis``/``tp_size`` additionally Megatron-shards the attention
@@ -235,14 +236,23 @@ def moe_layer_apply(cfg: ModelConfig, moe: MoEConfig, params: Dict,
     by construction (no per-expert-slot mask streams needed) and follows
     the same (key, shard, microbatch, layer, site) convention as the
     dense executor (tests/test_moe_pipeline.py asserts the partition
-    invariance). Dropout with ``sp_axis`` is rejected upstream (the
-    residual/FFN masks would need seq-sharded slicing — see
-    ``_check_moe_mesh``)."""
-    from ..ops.layers import dropout_apply
+    invariance). With ``sp_axis`` the residual/FFN masks are the
+    full-sequence masks' local slices (``sharded_dropout_apply`` over
+    dim 1, the dense sp path's rule), so a seq-sharded run reproduces
+    the unsharded masks exactly; attention-prob masks follow the
+    transport's own convention (Ulysses: oracle-exact post-scatter head
+    blocks; ring: blockwise global-coordinate masks)."""
+    from ..ops.layers import sharded_dropout_apply
     p = cfg.dropout if rng is not None else 0.0
 
     def site(i: int) -> Optional[jax.Array]:
         return None if rng is None else jax.random.fold_in(rng, i)
+
+    def drop(x, i):
+        # plain dropout_apply when sp_axis is None (the helper's own
+        # fallback), local mask slices when seq-sharded
+        return sharded_dropout_apply(x, p, site(i), axis=sp_axis,
+                                     n_shards=sp_size, shard_dim=1)
 
     a = layer_norm_apply(params["ln1"], h)
     if sp_axis is not None:
@@ -255,10 +265,10 @@ def moe_layer_apply(cfg: ModelConfig, moe: MoEConfig, params: Dict,
         attn = mha_apply(params["attn"], a, a, cfg.n_heads // tp_size,
                          causal=True, tp_axis=tp_axis, tp_size=tp_size,
                          dropout_rate=p, dropout_rng=site(0))
-    h = h + dropout_apply(attn, p, site(1))
+    h = h + drop(attn, 1)
     m = layer_norm_apply(params["ln2"], h)
     y, aux = moe_ffn_apply(params["moe"], m, moe, axis_name, tp_axis)
-    return h + dropout_apply(y, p, site(2)), aux
+    return h + drop(y, 2), aux
 
 
 def moe_lm_init(key: jax.Array, cfg: ModelConfig, moe: MoEConfig) -> Dict:

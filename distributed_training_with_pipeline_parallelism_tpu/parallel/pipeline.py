@@ -387,15 +387,9 @@ def _check_moe_mesh(cfg: ModelConfig, moe, T: int, n_seq: int,
     A seq axis composes since round 5: attention rides the ring/Ulysses
     transport while the (position-wise) MoE FFN routes each shard's
     local tokens with local capacity — the EP path's local-routing
-    semantics applied to the sequence dimension. Only dropout is still
-    excluded there (the residual/FFN masks would need the seq-sharded
-    slicing the dense sp path uses)."""
-    if n_seq > 1 and cfg.dropout > 0.0:
-        raise NotImplementedError(
-            "MoE x seq with train-mode dropout: the residual/FFN masks "
-            "are not plumbed through seq-sharded MoE stage bodies yet "
-            "(dense seq stages and unsharded-seq MoE both support "
-            "dropout)")
+    semantics applied to the sequence dimension. Dropout composes too:
+    the residual/FFN masks are the full-sequence masks' local slices
+    (``sharded_dropout_apply``, the dense sp path's rule)."""
     if cfg.arch != "gpt2":
         raise ValueError("MoE pipeline blocks are gpt2-style; set "
                          "arch='gpt2'")
@@ -724,7 +718,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     h, a = moe_layer_apply(cfg, moe, lp, h, ep_axis,
                                            tp_axis=tp_axis, tp_size=T,
                                            rng=rng_l, sp_axis=sp_axis,
-                                           sp_attn_impl=sp_attn_impl)
+                                           sp_attn_impl=sp_attn_impl,
+                                           sp_size=n_seq)
                     return (h, aux + a), None
 
                 if cfg.remat_layers:
@@ -1621,7 +1616,8 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
                     h, _aux = moe_layer_apply(cfg, moe, lp, h, ep_axis,
                                               tp_axis=tp_axis, tp_size=T,
                                               sp_axis=sp_axis,
-                                              sp_attn_impl=sp_attn_impl)
+                                              sp_attn_impl=sp_attn_impl,
+                                              sp_size=n_seq)
                     return h, None
 
                 y, _ = jax.lax.scan(mstep, x, layer_p)

@@ -154,9 +154,8 @@ def main():
     if args.ep > 1 and not args.moe_experts:
         ap.error("--ep requires --moe-experts")
     # --moe-experts composes with --tp (round 3: Megatron-split expert
-    # matmuls) and --sp (round 5: seq-sharded MoE stages); the library's
-    # _check_moe_mesh validates the genuinely-unsupported cases loudly
-    # (e.g. MoE x seq with dropout)
+    # matmuls) and --sp (round 5: seq-sharded MoE stages, incl. dropout);
+    # the library's _check_moe_mesh validates shape/arch contracts loudly
     if args.moe_experts and not args.model.startswith("gpt2-"):
         ap.error("--moe-experts uses gpt2-style blocks; pick a gpt2-* model")
     # --sp-attn ulysses composes with --tp since round 5 (the Megatron
