@@ -379,6 +379,20 @@ def _moe_fsdp_shard_dims(cfg: ModelConfig, moe, n_data: int, T: int,
         dim_for, template, specs, is_leaf=lambda x: isinstance(x, P))
 
 
+def _resolve_fsdp_dims(cfg: ModelConfig, moe, n_data: int, T: int,
+                       n_ep: int, fsdp: bool):
+    """The per-leaf fsdp 'data'-dim map shared by the training executor,
+    the forward-only eval program, and ``fsdp_shard_params`` — one
+    resolution site so train/eval/placement can never disagree about
+    where a leaf's 'data' shard lives (the silent-reshard drift the
+    helpers' docstrings warn about)."""
+    if not fsdp:
+        return None
+    if moe is not None:
+        return _moe_fsdp_shard_dims(cfg, moe, n_data, T, n_ep)
+    return _fsdp_shard_dims(cfg, n_data, T)
+
+
 def _check_moe_mesh(cfg: ModelConfig, moe, T: int, n_seq: int,
                     n_ep: int) -> None:
     """The MoE mesh-composition contract, shared by the training executor
@@ -557,12 +571,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 "pp x fsdp composes with dense or MoE data x pipe "
                 "(x model / x expert) meshes; the seq axis would need "
                 "activation resharding around every gathered chunk")
-    if not fsdp:
-        fsdp_dims = None
-    elif moe is not None:
-        fsdp_dims = _moe_fsdp_shard_dims(cfg, moe, n_data, T, n_ep)
-    else:
-        fsdp_dims = _fsdp_shard_dims(cfg, n_data, T)
+    fsdp_dims = _resolve_fsdp_dims(cfg, moe, n_data, T, n_ep, fsdp)
     use_dropout = cfg.dropout > 0.0
     # pad masking composes with every supported mesh, including MoE/expert
     # stages: the CE is globally valid-count normalized while the routing
@@ -1390,20 +1399,18 @@ def fsdp_shard_params(params: Pytree, cfg: ModelConfig, mesh: Mesh,
                          "shard parameters over (make_mesh(n_data=...))")
     T = mesh.shape.get(MODEL_AXIS, 1)
     n_ep = mesh.shape.get(EXPERT_AXIS, 1)
+    dims = _resolve_fsdp_dims(cfg, moe, n_data, T, n_ep, True)
     if moe is not None:
         # MoE resting layout (pp x fsdp x MoE): expert stacks over
         # 'expert', Megatron dims over 'model', fsdp 'data' on the
         # remaining free matrix dim — same per-leaf map the executor's
         # in/out specs use
-        dims = _moe_fsdp_shard_dims(cfg, moe, n_data, T, n_ep)
         base = _moe_template_specs(cfg, moe, T, n_ep)
+    elif T > 1:
+        from .tensor_parallel import _layer_specs
+        base = _layer_specs(cfg)
     else:
-        dims = _fsdp_shard_dims(cfg, n_data, T)
-        if T > 1:
-            from .tensor_parallel import _layer_specs
-            base = _layer_specs(cfg)
-        else:
-            base = jax.tree.map(lambda _: P(), dims)
+        base = jax.tree.map(lambda _: P(), dims)
 
     def put_layer(x, spec, dm):
         # full-model layer leaves are [L, w0, ...]: 'pipe' on the layer
@@ -1528,13 +1535,11 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
                 "rng into MoE stage bodies (the tick executor does, via "
                 "moe_layer_apply's per-layer rng); use the tick executor "
                 "for MoE training with dropout")
-        if fsdp:
-            raise ValueError("fsdp eval composes with dense stages only")
     if fsdp and (n_data <= 1 or n_seq > 1):
-        raise ValueError("fsdp eval needs a dense data x pipe (x model) "
-                         "mesh (matching the training-side pp x fsdp "
-                         "support)")
-    fsdp_dims = _fsdp_shard_dims(cfg, n_data, T) if fsdp else None
+        raise ValueError("fsdp eval needs a data x pipe (x model / x "
+                         "expert) mesh (matching the training-side "
+                         "pp x fsdp support)")
+    fsdp_dims = _resolve_fsdp_dims(cfg, moe, n_data, T, n_ep, fsdp)
     V = sched.n_virtual
     M = sched.n_microbatches
     tp_axis = MODEL_AXIS if T > 1 else None
@@ -1754,6 +1759,8 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
 
     if moe is not None:
         layer_spec = _moe_layer_specs(cfg, moe, T, n_ep)
+        if fsdp_dims is not None:
+            layer_spec = _merge_fsdp_into_stacked(layer_spec, fsdp_dims)
     elif T > 1 or fsdp:
         layer_spec = _dense_layer_specs(cfg, T, fsdp_dims)
     else:
