@@ -533,7 +533,9 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     stages too (round 5): expert matrices pick a 'data' dim disjoint
     from both the EP-owned expert dim and the Megatron dim
     (:func:`_moe_fsdp_shard_dims`) — expert models are precisely where
-    parameter sharding pays. Only the seq axis remains excluded.
+    parameter sharding pays. A seq axis composes too (round 5): the
+    weight all-gathers ride 'data' while activations shard over 'seq' —
+    orthogonal by construction.
     """
     D = mesh.shape[PIPE_AXIS]
     n_data = mesh.shape.get(DATA_AXIS, 1)
@@ -562,15 +564,14 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     ep_axis = EXPERT_AXIS if n_ep > 1 else None
     if n_ep > 1 and moe is None:
         raise ValueError("mesh has an 'expert' axis but no MoEConfig given")
-    if fsdp:
-        if n_data <= 1:
-            raise ValueError("fsdp=True needs a 'data' mesh axis to shard "
-                             "parameters over")
-        if n_seq > 1:
-            raise NotImplementedError(
-                "pp x fsdp composes with dense or MoE data x pipe "
-                "(x model / x expert) meshes; the seq axis would need "
-                "activation resharding around every gathered chunk")
+    if fsdp and n_data <= 1:
+        raise ValueError("fsdp=True needs a 'data' mesh axis to shard "
+                         "parameters over")
+    # fsdp x seq composes (round 5): the weight all-gathers ride the
+    # 'data' axis while activations shard over 'seq' — orthogonal by
+    # construction, and the epilogue's per-leaf reductions already do the
+    # right thing (psum_scatter over 'data' per tick, then the seq psum
+    # completes every leaf's token share)
     fsdp_dims = _resolve_fsdp_dims(cfg, moe, n_data, T, n_ep, fsdp)
     use_dropout = cfg.dropout > 0.0
     # pad masking composes with every supported mesh, including MoE/expert
@@ -1535,10 +1536,9 @@ def _build_forward_program(cfg: ModelConfig, mesh: Mesh,
                 "rng into MoE stage bodies (the tick executor does, via "
                 "moe_layer_apply's per-layer rng); use the tick executor "
                 "for MoE training with dropout")
-    if fsdp and (n_data <= 1 or n_seq > 1):
-        raise ValueError("fsdp eval needs a data x pipe (x model / x "
-                         "expert) mesh (matching the training-side "
-                         "pp x fsdp support)")
+    if fsdp and n_data <= 1:
+        raise ValueError("fsdp eval needs a 'data' mesh axis (matching "
+                         "the training-side pp x fsdp support)")
     fsdp_dims = _resolve_fsdp_dims(cfg, moe, n_data, T, n_ep, fsdp)
     V = sched.n_virtual
     M = sched.n_microbatches
