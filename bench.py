@@ -19,11 +19,17 @@ and the metric label states which ran. Also timed, under "extra":
    (one fused full-batch step, identical loss/grads, tested): the model+
    loss compute ceiling. ceiling/headline IS the executor overhead
    (reported as ``tick_executor_overhead``, > 1).
-2. ``tick_executor_remat`` — the cond-dispatched tick scan with
-   ``remat_backward=True`` (round-2's only mode; the D>1 default).
-   ``stored_backward_speedup`` (headline/remat) is reported only where
-   the headline actually ran the stored form (1 chip).
-3. ``gpt2_small_1024`` / ``gpt2_medium_1024`` — GPT-2 124M/355M at
+2. ``tick_executor_remat`` — the remat tick program under the AUTO
+   executor formulation (unrolled at this table size; the D>1 default
+   policy). ``stored_backward_speedup`` (headline/remat) is reported only
+   where the headline actually ran the stored form (1 chip).
+3. ``phase_executor`` / ``tick_executor_scan`` — the same remat tick
+   program under ``unroll_ticks="phases"`` (per-pattern specialized scan
+   bodies) and ``unroll_ticks=False`` (cond-dispatched whole-table scan):
+   with the per-row ``compile_s`` column this captures the executor-
+   formulation trade (throughput vs compile time) the phase-compressed
+   mode exists to close.
+4. ``gpt2_small_1024`` / ``gpt2_medium_1024`` — GPT-2 124M/355M at
    seq 1024, bf16: real model families at a real sequence length
    (flash-attention kernel active per the "auto" policy).
 
@@ -90,8 +96,14 @@ def train_flops_per_token(cfg, seq: int) -> float:
 def _time_step(step, params, tokens, targets, num_iterations):
     from distributed_training_with_pipeline_parallelism_tpu.utils.metrics import (
         force_completion)
-    for _ in range(2):  # warmup, untimed (reference :113-118)
-        force_completion(step(params, tokens, targets))
+    # First warmup call = trace + XLA compile (+ one execution, negligible
+    # next to compile at these sizes): the executor-formulation compile
+    # economics the phase-compressed mode exists to fix, reported per row
+    # as compile_s.
+    start = time.perf_counter()
+    force_completion(step(params, tokens, targets))
+    compile_s = time.perf_counter() - start
+    force_completion(step(params, tokens, targets))  # second warmup, untimed
     # Median of 3 measurement windows (the device tunnel is jittery). Each
     # window ends with a host fetch of the final loss: block_until_ready is
     # not a reliable execution barrier through the remote-device tunnel, but
@@ -104,31 +116,35 @@ def _time_step(step, params, tokens, targets, num_iterations):
             loss, grads = step(params, tokens, targets)
         force_completion(loss)
         elapsed_runs.append(time.perf_counter() - start)
-    return sorted(elapsed_runs)[1]
+    return sorted(elapsed_runs)[1], compile_s
 
 
 def run_config(cfg, batch_size, seq_length, num_iterations=20,
                schedule="GPipe", n_microbatches=4,
-               force_tick_executor=False, remat_backward=None) -> dict:
+               force_tick_executor=False, remat_backward=None,
+               unroll_ticks=None) -> dict:
     n_pipe = len(jax.devices())  # 1-D pipeline mesh over every visible chip
     sched = dtpp.ScheduleConfig(name=schedule, n_microbatches=n_microbatches)
     mesh = make_mesh(n_pipe=n_pipe)
     step = make_pipeline_step(cfg, mesh, sched,
                               force_tick_executor=force_tick_executor,
-                              remat_backward=remat_backward)
+                              remat_backward=remat_backward,
+                              unroll_ticks=unroll_ticks)
     params = tfm.transformer_init(jax.random.key(0), cfg)
     tokens = jax.random.randint(jax.random.key(1), (batch_size, seq_length),
                                 0, cfg.vocab_size)
     targets = jax.random.randint(jax.random.key(2), (batch_size, seq_length),
                                  0, cfg.vocab_size)
-    elapsed = _time_step(step, params, tokens, targets, num_iterations)
+    elapsed, compile_s = _time_step(step, params, tokens, targets,
+                                    num_iterations)
     tokens_processed = batch_size * seq_length * num_iterations
     throughput = tokens_processed / elapsed
     flops_tok = train_flops_per_token(cfg, seq_length)
     mfu = throughput * flops_tok / (chip_peak_flops() * n_pipe)
     return {"tokens_per_sec": round(throughput, 2),
             "mfu": round(mfu, 4),
-            "elapsed_s": round(elapsed, 3)}
+            "elapsed_s": round(elapsed, 3),
+            "compile_s": round(compile_s, 2)}
 
 
 def run(num_iterations: int = 20) -> dict:
@@ -163,6 +179,24 @@ def run(num_iterations: int = 20) -> dict:
                 headline["tokens_per_sec"] / remat["tokens_per_sec"], 3)
     except Exception as e:  # pragma: no cover - hardware-dependent
         extra["tick_executor_remat"] = {"error": str(e)}
+    # executor-formulation triangle on the same remat tick program
+    # (docs/performance.md "Executor formulations"): the auto row above
+    # unrolls at this table size (~2.2 s/row compile), phase_executor
+    # scans per-pattern specialized bodies (compile ~ unique patterns),
+    # tick_executor_scan is the cond-dispatched whole-table scan — each
+    # row's compile_s is the column that captures the trade
+    try:
+        extra["phase_executor"] = run_config(
+            ref_cfg, 32, 128, num_iterations, force_tick_executor=True,
+            remat_backward=True, unroll_ticks="phases")
+    except Exception as e:  # pragma: no cover - hardware-dependent
+        extra["phase_executor"] = {"error": str(e)}
+    try:
+        extra["tick_executor_scan"] = run_config(
+            ref_cfg, 32, 128, num_iterations, force_tick_executor=True,
+            remat_backward=True, unroll_ticks=False)
+    except Exception as e:  # pragma: no cover - hardware-dependent
+        extra["tick_executor_scan"] = {"error": str(e)}
     # tie_embeddings=True is the real GPT-2 124M (and keeps the MFU's 6*N
     # honest: the tied table is the head matmul); unroll_layers +
     # batch 16/8 are the measured round-3 MFU levers (docs/performance.md)

@@ -737,12 +737,22 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if window is not None and (not causal or window < 1):
         raise ValueError("window requires causal attention and window >= 1")
     b, s, h, dh = q.shape
-    # Clamp to the sequence so short full-length rows (s <= 1024, where
-    # _auto_block returns 1024) still satisfy _packed_ok's s % block_q == 0
-    # and take the transpose-free packed path (block_q == s is an admissible
-    # packed-lse config under the Mosaic lane constraint).
-    block_q = min(block_q or _auto_block(s), s)
-    block_k = min(block_k or _auto_block(s), s)
+    # AUTO blocks clamp to the sequence so short full-length rows
+    # (s <= 1024, where _auto_block returns 1024) still satisfy
+    # _packed_ok's s % block_q == 0 and take the transpose-free packed
+    # path (block_q == s is an admissible packed-lse config under the
+    # Mosaic lane constraint). EXPLICIT blocks are taken literally: a
+    # caller-tuned block larger than the sequence is a config error, and
+    # silently clamping it made "why is my tuned block ignored?"
+    # undiagnosable (ADVICE r5) — raise instead.
+    for name, blk in (("block_q", block_q), ("block_k", block_k)):
+        if blk is not None and blk > s:
+            raise ValueError(
+                f"explicit {name}={blk} exceeds the sequence length {s}; "
+                f"pass {name}=None to let _auto_block pick (auto blocks "
+                f"clamp to the sequence)")
+    block_q = block_q or min(_auto_block(s), s)
+    block_k = block_k or min(_auto_block(s), s)
     if _packed_ok(s, h, dh, causal, window, block_q, block_k,
                   q.dtype.itemsize):
         # transpose-free path: heads stay packed in the lane dimension

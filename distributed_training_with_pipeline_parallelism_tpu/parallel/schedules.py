@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import heapq
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -906,6 +906,140 @@ def verify_table(cs: CompiledSchedule) -> None:
         ok = fwd_done == want and bwd_done == want and not w_done
     if not ok:
         raise ScheduleError("table does not execute every (stage, microbatch)")
+
+
+# ---------------------------------------------------------------------------
+# Phase compression: the periodic-steady-state structure of a tick table
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase:
+    """One maximal periodic run of tick-table rows.
+
+    Covers rows ``[start, start + period * reps)``. ``base`` is the first
+    repetition's row block ``[period, D, n_cols]``; repetition ``k``
+    (``0 <= k < reps``) is exactly ``base + k * stride`` — active entries
+    (``>= 0``) advance affinely per repetition (microbatch counters step,
+    slot indices step or, over a period spanning a full slot-reuse cycle,
+    stay put), inactive entries stay ``-1`` (``stride`` is 0 there). The
+    *pattern* — which units run and which transfer channels are live on
+    each device at each period position — is ``base >= 0`` and is constant
+    across repetitions by construction, which is what lets the executor
+    compile ONE specialized body per pattern and drive the run as a
+    ``lax.scan`` (``unroll_ticks="phases"``). Rows that match no period
+    fall out of :func:`compress_schedule` as ``period=1, reps=1`` phases.
+    """
+
+    start: int
+    period: int
+    reps: int
+    base: np.ndarray    # [period, D, n_cols] int32
+    stride: np.ndarray  # [period, D, n_cols] int32; 0 on inactive entries
+
+    @property
+    def length(self) -> int:
+        return self.period * self.reps
+
+    def pattern_key(self) -> Tuple[int, bytes]:
+        """Hashable identity of the active/idle structure: the executor
+        compiles one tick body per distinct key (slot/microbatch VALUES are
+        scanned inputs, only this mask shapes the program)."""
+        return (self.period, (self.base >= 0).tobytes())
+
+
+def rows_of(phase: Phase) -> np.ndarray:
+    """Materialize one phase's rows ``[length, D, n_cols]`` from its
+    descriptor alone (base + per-rep stride; no table reference)."""
+    ks = np.arange(phase.reps, dtype=phase.base.dtype)
+    blocks = phase.base[None] + ks[:, None, None, None] * phase.stride[None]
+    return blocks.reshape(phase.reps * phase.period, *phase.base.shape[1:])
+
+
+def replay_phases(phases: Sequence[Phase]) -> np.ndarray:
+    """Reconstruct the full tick table from phase descriptors —
+    :func:`compress_schedule`'s inverse, and the property the compression
+    self-check (and tests/test_schedules.py) assert bit-exactly."""
+    return np.concatenate([rows_of(p) for p in phases], axis=0)
+
+
+def compress_schedule(table: np.ndarray,
+                      max_period: Optional[int] = None) -> Tuple[Phase, ...]:
+    """Segment a tick table into maximal periodic runs (:class:`Phase`).
+
+    Every schedule we execute is warmup + a periodic steady state +
+    cooldown (arXiv:2401.10241's zero-bubble family makes the periodicity
+    explicit; the tabular view of arXiv:2605.24006 makes it statically
+    detectable from the rows). A run of period ``p`` starting at ``t``
+    requires, for each repetition ``k``: the active/idle mask of rows
+    ``table[t+k*p : t+(k+1)*p]`` equals the first repetition's, and active
+    entries advance affinely (``base + k * stride``). Mask-alternating
+    steady states (1F1B's F/B interleave) land at ``p >= 2``; cyclic slot
+    reuse is absorbed by a period spanning the whole reuse cycle (slot
+    stride 0, microbatch stride = slots per cycle). Greedy: at each row
+    take the (period, reps) with maximal coverage, smallest period on
+    ties; rows matching no period become ``period=1, reps=1`` phases
+    (warmup/cooldown transients). The result is self-checked against
+    :func:`replay_phases` before being returned.
+    """
+    table = np.asarray(table)
+    T = table.shape[0]
+    if max_period is None:
+        max_period = min(T // 2, 64)
+    phases: List[Phase] = []
+    t = 0
+    while t < T:
+        best = None  # (coverage, -period, period, reps, stride)
+        rem = T - t
+        for p in range(1, min(max_period, rem // 2) + 1):
+            base = table[t:t + p]
+            mask = base >= 0
+            nxt = table[t + p:t + 2 * p]
+            if ((nxt >= 0) != mask).any():
+                continue
+            stride = np.where(mask, nxt - base, 0).astype(table.dtype)
+            if not np.array_equal(base + stride, nxt):
+                continue  # inactive entries drifted (non -1 sentinel)
+            reps = 2
+            while t + (reps + 1) * p <= T:
+                blk = table[t + reps * p:t + (reps + 1) * p]
+                # mask equality is checked separately: an active entry
+                # walking onto -1 by arithmetic coincidence must NOT count
+                # as a match — the executor's per-position specialization
+                # relies on the mask being constant across repetitions
+                if (((blk >= 0) == mask).all()
+                        and np.array_equal(blk, base + reps * stride)):
+                    reps += 1
+                else:
+                    break
+            cand = (p * reps, -p, p, reps, stride)
+            if best is None or cand[:2] > best[:2]:
+                best = cand
+        if best is not None:
+            _, _, p, reps, stride = best
+            phases.append(Phase(t, p, reps, table[t:t + p].copy(), stride))
+            t += p * reps
+        else:
+            phases.append(Phase(t, 1, 1, table[t:t + 1].copy(),
+                                np.zeros((1,) + table.shape[1:],
+                                         dtype=table.dtype)))
+            t += 1
+    out = tuple(phases)
+    if not np.array_equal(replay_phases(out), table):  # pragma: no cover
+        raise ScheduleError("phase compression self-check failed: replay "
+                            "does not reconstruct the tick table")
+    return out
+
+
+def phase_stats(phases: Sequence[Phase]) -> Dict[str, int]:
+    """Compression summary: total rows, phase count, and the number of
+    distinct patterns (= tick bodies the phase executor compiles, before
+    the successor-mask refinement that may add a couple more)."""
+    return {
+        "n_rows": sum(p.length for p in phases),
+        "n_phases": len(phases),
+        "n_unique_patterns": len({p.pattern_key() for p in phases}),
+    }
 
 
 # ---------------------------------------------------------------------------

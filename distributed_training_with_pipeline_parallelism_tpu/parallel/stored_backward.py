@@ -23,6 +23,23 @@ depend on the stage input x?":
   x-dependent chain feeds nothing (the stored leaves replace it) and XLA's
   dead-code elimination removes it, so no forward matmul is recomputed.
 
+**Slot-buffer lifetime under the phase-compressed executor** (``unroll_
+ticks="phases"``): the residual slot buffers live in the tick carry, and
+:func:`.pipeline._phase_compressed_ticks` threads ONE carry through every
+per-phase ``lax.scan`` — a residual banked by a forward tick in one phase
+(e.g. the warmup) survives phase boundaries untouched until the backward
+tick that consumes it, possibly several scans later (1F1B's last warmup
+residuals are read deep into the cooldown). Nothing about slot lifetime is
+phase-local: slots are allocated against the WHOLE table
+(``schedules._allocate_slots``), phases only re-group the iteration order
+of the same rows, and the per-phase scans neither reset nor re-shape the
+carry. The one interaction to keep in mind is memory, not correctness:
+each scan boundary materializes the full carry — including every slot
+buffer — in HBM, so the stored policy pays the buffer HBM round-trip once
+per phase transition rather than once per tick (cheaper than the plain
+scan, more than the fully unrolled form, where XLA may keep residuals in
+registers across ticks).
+
 The analysis is a conservative taint propagation over the jaxpr of the
 residual extraction, descending into scan (with carry-feedback fixpoint),
 cond (union over branches), and single-subjaxpr call primitives

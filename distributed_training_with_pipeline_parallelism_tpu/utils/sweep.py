@@ -40,13 +40,16 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                        n_microbatches: int = 4, seed: int = 0,
                        arch: str = "ref_decoder",
                        dtype: str = "float32",
-                       remat_backward=None) -> Dict[str, float]:
+                       remat_backward=None,
+                       unroll_ticks=None) -> Dict[str, float]:
     """Run one pipeline experiment; returns the reference's metrics dict plus
     bubble analytics, or ``{"error": ...}`` on failure.
 
     Self-describing columns (so the artifact cannot be misread without its
     docs): ``backward_policy`` records which backward the executor compiled
-    ('stored' or 'remat'), ``bubble_sim_w_b`` the matching per-tick backward
+    ('stored' or 'remat'), ``tick_executor`` which tick-loop formulation
+    ('unrolled', 'scan', or 'phases' — the ``unroll_ticks`` resolution),
+    ``bubble_sim_w_b`` the matching per-tick backward
     weight the ``bubble_simulated`` column was computed under, and
     ``host_serialized`` whether the mesh was CPU-simulated on a host — where
     every "parallel" tick serializes, wall-clock measures total work plus
@@ -74,7 +77,8 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                                n_virtual=n_virtual)
         mesh = make_mesh(n_pipe=num_devices)
         step = make_pipeline_step(cfg, mesh, sched,
-                                  remat_backward=remat_backward)
+                                  remat_backward=remat_backward,
+                                  unroll_ticks=unroll_ticks)
 
         params = transformer_init(jax.random.key(seed), cfg)
         kx, ky = jax.random.split(jax.random.key(seed + 1))
@@ -107,6 +111,13 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
             "bubble_simulated": sim["bubble_fraction"],
             "bubble_sim_w_b": w_b,
             "backward_policy": "stored" if stored else "remat",
+            # which tick-loop formulation compiled (mirrors the auto
+            # resolution in make_pipeline_grad_fn; 'unrolled' also covers
+            # the D==1 stored program, which is unrolled by construction)
+            "tick_executor": (
+                {True: "unrolled", False: "scan", "phases": "phases"}
+                [unroll_ticks] if unroll_ticks is not None
+                else ("unrolled" if cs.table.shape[0] <= 64 else "phases")),
             "host_serialized": jax.devices()[0].platform == "cpu",
         })
         return metrics

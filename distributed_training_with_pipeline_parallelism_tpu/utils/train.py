@@ -33,6 +33,7 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     sp_attn_impl: str = "ring",
                     tp_vocab_parallel: bool = False,
                     fsdp: bool = False, remat_backward=None,
+                    unroll_ticks=None,
                     ) -> Callable[[Pytree, Any, jax.Array, jax.Array],
                                   Tuple[Pytree, Any, jax.Array]]:
     """Jitted ``(params, opt_state, tokens, targets) ->
@@ -45,11 +46,16 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     elementwise — runs shard-local and moments are born sharded).
     ``remat_backward`` picks the backward's activation policy (None = auto:
     stored where supported; True = rematerialize for minimal activation
-    memory — see :func:`..parallel.pipeline.make_pipeline_grad_fn`)."""
+    memory — see :func:`..parallel.pipeline.make_pipeline_grad_fn`).
+    ``unroll_ticks`` picks the tick-executor formulation (None = auto:
+    unrolled up to 64 table rows, phase-compressed scan beyond; also
+    ``True``/``False``/``"phases"`` — compile-time economics in
+    :func:`..parallel.pipeline.make_pipeline_grad_fn`)."""
     grad_fn = make_pipeline_grad_fn(cfg, mesh, sched, moe=moe,
                                     sp_attn_impl=sp_attn_impl,
                                     tp_vocab_parallel=tp_vocab_parallel,
-                                    fsdp=fsdp, remat_backward=remat_backward)
+                                    fsdp=fsdp, remat_backward=remat_backward,
+                                    unroll_ticks=unroll_ticks)
 
     if cfg.dropout > 0.0:
         # train-mode dropout: the step takes a per-step PRNG key
@@ -213,6 +219,7 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         metrics_path: Optional[str] = None, moe=None,
         sp_attn_impl: str = "ring", tp_vocab_parallel: bool = False,
         zero1: bool = False, fsdp: bool = False, remat_backward=None,
+        unroll_ticks=None,
         dropout_seed: int = 0,
         eval_data: Optional[Callable[[], Iterator]] = None,
         eval_every: int = 0, eval_batches: int = 8,
@@ -265,7 +272,8 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
     step_fn = make_train_step(cfg, mesh, sched, optimizer, moe=moe,
                               sp_attn_impl=sp_attn_impl,
                               tp_vocab_parallel=tp_vocab_parallel,
-                              fsdp=fsdp, remat_backward=remat_backward)
+                              fsdp=fsdp, remat_backward=remat_backward,
+                              unroll_ticks=unroll_ticks)
     if fsdp and zero1:
         raise ValueError("fsdp already shards optimizer state (ZeRO-3 "
                          "subsumes ZeRO-1) — drop --zero1")

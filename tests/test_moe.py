@@ -172,3 +172,52 @@ def test_moe_lm_embed_scale_matches_prescaled_table():
     got = moe_lm_loss(cfg_s, moe, params, tokens, targets)
     want = moe_lm_loss(cfg_o, moe, oracle, tokens, targets)
     np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+
+
+def test_seq_sharded_moe_local_capacity_drops():
+    """Per-shard LOCAL-capacity semantics under seq sharding, in the
+    drop-inducing regime (docs/parallelism.md "MoE x seq"): capacity is
+    computed from the LOCAL token count, so a seq-sharded run can drop
+    tokens an unsharded run keeps — C = max(1, ceil(top_k*T_local*cf/E))
+    rounds down harder as n_seq grows. The sharded path must equal the
+    per-shard oracle (the unsharded kernel applied to each local slice),
+    NOT the full-sequence unsharded run."""
+    from jax.sharding import PartitionSpec as P
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+        SEQ_AXIS, make_sp_mesh)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        _shard_map)
+    E, d, f = 2, 4, 8
+    B, S, n_seq = 1, 8, 2
+    moe = MoEConfig(n_experts=E, top_k=1, capacity_factor=0.5, ffn_dim=f)
+    # global capacity: ceil(1*8*0.5/2) = 2 slots/expert; local (4 tokens):
+    # ceil(1*4*0.5/2) = 1 — the sharded run keeps strictly fewer tokens
+    assert moe.capacity(B * S) == 2 and moe.capacity(B * S // n_seq) == 1
+    params = moe_ffn_init(jax.random.key(0), d, f, E)
+    # deterministic routing on feature 0: x0 > 0 -> expert 0, else expert 1
+    params = dict(params, router={"w": jnp.zeros((d, E)).at[0, 0].set(8.0)
+                                  .at[0, 1].set(-8.0)})
+    x = 0.1 * jax.random.normal(jax.random.key(1), (B, S, d))
+    # shard 0's tokens (0-3) all pick expert 0, shard 1's (4-7) expert 1
+    x = x.at[:, :4, 0].set(1.0).at[:, 4:, 0].set(-1.0)
+
+    mesh = make_sp_mesh(n_seq)
+    sharded = _shard_map(
+        lambda p, x: moe_ffn_apply(p, x, moe)[0], mesh,
+        in_specs=(P(), P(None, SEQ_AXIS)), out_specs=P(None, SEQ_AXIS))
+    y_sharded = np.asarray(jax.jit(sharded)(params, x))
+    y_full = np.asarray(moe_ffn_apply(params, x, moe)[0])
+    # per-shard oracle: the unsharded kernel on each local slice
+    y_oracle = np.concatenate(
+        [np.asarray(moe_ffn_apply(params, x[:, s0:s0 + S // n_seq], moe)[0])
+         for s0 in range(0, S, S // n_seq)], axis=1)
+    np.testing.assert_allclose(y_sharded, y_oracle, rtol=1e-5, atol=1e-6)
+    # drops really occurred: per shard only 1 of 4 tokens got a slot
+    # (dropped tokens have zero combine weight -> zero FFN output)
+    kept = (np.abs(y_sharded[0]).sum(-1) > 1e-7)
+    assert kept.sum() == 2, kept
+    # and the local-capacity run keeps FEWER than the unsharded run (2 vs
+    # 4) — the two are legitimately different programs in the drop regime
+    kept_full = (np.abs(y_full[0]).sum(-1) > 1e-7)
+    assert kept_full.sum() == 4, kept_full
+    assert not np.allclose(y_sharded, y_full)

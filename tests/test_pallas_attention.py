@@ -155,7 +155,7 @@ def test_flash_window_requires_causal():
     (12, 8, 5),      # the reproduced ragged corruption case
     (29, 8, None),   # ragged, plain causal
     (29, 8, 7),
-    (13, 16, None),  # seq smaller than the block
+    (13, None, None),  # seq smaller than the auto block: auto path clamps
 ])
 def test_flash_ragged_seq_lengths(s, block, window):
     """Sequence lengths that do not divide the block size: padded keys must
@@ -172,6 +172,19 @@ def test_flash_ragged_seq_lengths(s, block, window):
                           window=window)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("kwargs", [
+    {"block_q": 16}, {"block_k": 16}, {"block_q": 16, "block_k": 16},
+])
+def test_flash_explicit_block_exceeds_seq_raises(kwargs):
+    """Explicit block sizes larger than the sequence are a caller error:
+    silently clamping them used to hide mis-sized launch configs. Only the
+    auto path (block=None) may clamp to the sequence length."""
+    s = 13
+    q = jnp.zeros((1, s, 2, 8))
+    with pytest.raises(ValueError, match="exceeds the sequence length"):
+        flash_attention(q, q, q, causal=True, **kwargs)
 
 
 @pytest.mark.parametrize("causal", [False, True])

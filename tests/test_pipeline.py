@@ -235,3 +235,124 @@ def test_auto_unroll_past_32_rows_matches_scan(problem):
     la, ga = make_pipeline_step(CFG, mesh, sched,
                                 remat_backward=True)(params, tokens, targets)
     assert_matches_reference(la, ga, ref_loss, ref_grads)
+
+
+def test_phase_executor_matches_scan_light(problem):
+    """The phase-compressed executor (unroll_ticks="phases") is the same
+    program as the cond-dispatched scan — identical loss/grads — and both
+    match the unrolled form and the single-device oracle. Light config for
+    tier-1; the full six-schedule grid is the slow-marked test below."""
+    params, tokens, targets, ref_loss, ref_grads = problem
+    mesh = make_mesh(n_pipe=2)
+    sched = dtpp.ScheduleConfig(name="1F1B", n_microbatches=4)
+    outs = {}
+    for mode in ("phases", False, True):
+        outs[mode] = make_pipeline_step(
+            CFG, mesh, sched, remat_backward=True, unroll_ticks=mode)(
+            params, tokens, targets)
+    lp, gp = outs["phases"]
+    for other in (False, True):
+        lo, go = outs[other]
+        assert float(jnp.abs(lp - lo)) == 0.0, other
+        err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                           gp, go)
+        assert max(jax.tree.leaves(err)) == 0.0, other
+    assert_matches_reference(lp, gp, ref_loss, ref_grads)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,D,V,M,kw", [
+    ("GPipe", 2, 1, 4, {}),
+    ("1F1B", 4, 1, 8, {}),
+    ("1F1B", 2, 1, 4, {"remat_backward": False}),  # stored (slot-banked vjp)
+    ("Interleaved1F1B", 2, 2, 4, {}),
+    ("BFS", 2, 2, 4, {}),
+    ("ZBH1", 4, 1, 8, {}),
+    ("ZBV", 2, 2, 4, {}),
+])
+def test_phase_executor_matches_scan_all_schedules(problem, name, D, V, M, kw):
+    """Acceptance grid: bit-exact phases-vs-scan parity on every builtin
+    schedule family (incl. split-backward ZB and the stored policy)."""
+    params, tokens, targets, ref_loss, ref_grads = problem
+    mesh = make_mesh(n_pipe=D)
+    sched = dtpp.ScheduleConfig(name=name, n_microbatches=M, n_virtual=V)
+    kw = dict({"remat_backward": True}, **kw)
+    lp, gp = make_pipeline_step(CFG, mesh, sched, unroll_ticks="phases",
+                                **kw)(params, tokens, targets)
+    ls, gs = make_pipeline_step(CFG, mesh, sched, unroll_ticks=False,
+                                **kw)(params, tokens, targets)
+    assert float(jnp.abs(lp - ls)) == 0.0
+    err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), gp, gs)
+    assert max(jax.tree.leaves(err)) == 0.0
+    assert_matches_reference(lp, gp, ref_loss, ref_grads)
+
+
+@pytest.mark.slow
+def test_phase_executor_matches_scan_custom_schedule(problem):
+    """register_schedule tables run the phase executor too (acceptance:
+    one custom schedule in the parity grid)."""
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules import (
+        Action, B, F, register_schedule, unregister_schedule)
+
+    def reverse_drain(D, V, M):
+        del V
+        return [[Action(d, F, m) for m in range(M)]
+                + [Action(d, B, m) for m in reversed(range(M))]
+                for d in range(D)]
+
+    params, tokens, targets, ref_loss, ref_grads = problem
+    register_schedule("PhaseRevDrain", reverse_drain)
+    try:
+        mesh = make_mesh(n_pipe=2)
+        sched = dtpp.ScheduleConfig(name="PhaseRevDrain", n_microbatches=4)
+        lp, gp = make_pipeline_step(CFG, mesh, sched, remat_backward=True,
+                                    unroll_ticks="phases")(
+            params, tokens, targets)
+        ls, gs = make_pipeline_step(CFG, mesh, sched, remat_backward=True,
+                                    unroll_ticks=False)(
+            params, tokens, targets)
+        assert float(jnp.abs(lp - ls)) == 0.0
+        err = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                           gp, gs)
+        assert max(jax.tree.leaves(err)) == 0.0
+        assert_matches_reference(lp, gp, ref_loss, ref_grads)
+    finally:
+        unregister_schedule("PhaseRevDrain")
+
+
+def test_phase_executor_trace_count(problem):
+    """Acceptance: the number of PYTHON TRACES of phase bodies (each trace
+    = one compiled tick body; lax.scan caches body jaxprs per function
+    object) is bounded by unique patterns + 2, and is INDEPENDENT of M for
+    steady-state-periodic 1F1B — the whole point of the formulation.
+    Trace-only (jit lower, no XLA compile) keeps this test cheap."""
+    from distributed_training_with_pipeline_parallelism_tpu.parallel import (
+        pipeline as pl)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules import (
+        compress_schedule, phase_stats)
+
+    params, tokens, targets, _, _ = problem
+    mesh = make_mesh(n_pipe=4)
+    counts = {}
+    for M in (8, 16):
+        sched = dtpp.ScheduleConfig(name="1F1B", n_microbatches=M)
+        n = 0
+
+        def hook():
+            nonlocal n
+            n += 1
+
+        fn = pl.make_pipeline_grad_fn(CFG, mesh, sched, remat_backward=True,
+                                      unroll_ticks="phases")
+        pl._PHASE_TRACE_HOOK = hook
+        try:
+            jax.jit(fn).lower(params, tokens, targets)
+        finally:
+            pl._PHASE_TRACE_HOOK = None
+        assert n > 0
+        st = phase_stats(compress_schedule(pl._compile("1F1B", 4, 1, M).table))
+        assert n <= st["n_unique_patterns"] + 2, (M, n, st)
+        counts[M] = n
+    # the compile-cost invariant: more microbatches = more ticks but the
+    # SAME set of tick bodies (steady state grows in reps, not patterns)
+    assert counts[8] == counts[16], counts

@@ -251,3 +251,97 @@ def test_slot_allocation_memory_advantage():
     # interleaved with V virtual stages stays bounded by ~S in-flight
     il = compile_schedule("Interleaved1F1B", 4, 2, 8)
     assert il.n_act_slots < 2 * il.n_microbatches
+
+
+# ---------------------------------------------------------------------------
+# Phase compression (the `unroll_ticks="phases"` executor's schedule pass)
+# ---------------------------------------------------------------------------
+
+
+_PHASE_GRID = [
+    ("GPipe", 1, 1, 32), ("GPipe", 2, 1, 4), ("GPipe", 4, 1, 16),
+    ("GPipe", 8, 1, 8),
+    ("1F1B", 2, 1, 4), ("1F1B", 4, 1, 8), ("1F1B", 4, 1, 16),
+    ("1F1B", 8, 1, 16),
+    ("Interleaved1F1B", 2, 2, 4), ("Interleaved1F1B", 4, 2, 8),
+    ("Interleaved1F1B", 2, 3, 6),
+    ("BFS", 2, 2, 4), ("BFS", 4, 2, 8), ("BFS", 8, 2, 4),
+    ("ZBH1", 2, 1, 4), ("ZBH1", 4, 1, 8),
+    ("ZBV", 2, 2, 4), ("ZBV", 4, 2, 8),
+]
+
+
+@pytest.mark.parametrize("name,D,V,M", _PHASE_GRID)
+def test_phase_replay_reconstructs_table(name, D, V, M):
+    """THE compression invariant: replaying the phase descriptors
+    reconstructs the tick table bit-exactly, for every registered schedule
+    across the (D, V, M) grid. The executor's correctness reduces to this
+    plus the (separately tested) executor parity, so it must hold with no
+    tolerance."""
+    cs = compile_schedule(name, D, V, M)
+    phases = sch.compress_schedule(cs.table)
+    assert np.array_equal(sch.replay_phases(phases), cs.table)
+    # phases tile the table contiguously, in order, with no gaps
+    pos = 0
+    for ph in phases:
+        assert ph.start == pos
+        assert ph.period >= 1 and ph.reps >= 1
+        pos += ph.length
+    assert pos == cs.table.shape[0]
+    st = sch.phase_stats(phases)
+    assert st["n_rows"] == cs.table.shape[0]
+    assert st["n_unique_patterns"] <= st["n_phases"]
+
+
+def test_phase_replay_custom_schedule():
+    """register_schedule tables go through the same pass: a LIFO-drain
+    GPipe variant no builtin produces."""
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules import (
+        Action, F, B, register_schedule, unregister_schedule)
+
+    def reverse_drain(D, V, M):
+        del V
+        return [[Action(d, F, m) for m in range(M)]
+                + [Action(d, B, m) for m in reversed(range(M))]
+                for d in range(D)]
+
+    register_schedule("PhaseReverseDrain", reverse_drain)
+    try:
+        cs = compile_schedule("PhaseReverseDrain", 2, 1, 8)
+        phases = sch.compress_schedule(cs.table)
+        assert np.array_equal(sch.replay_phases(phases), cs.table)
+    finally:
+        unregister_schedule("PhaseReverseDrain")
+
+
+def test_phase_compression_actually_compresses():
+    # the steady state must not fall out as all length-1 phases: GPipe
+    # D=1 (pure F* then B* runs) compresses to a handful of descriptors,
+    # and 1F1B's F/B alternation is caught as multi-rep phases
+    t_gpipe = compile_schedule("GPipe", 1, 1, 32).table
+    assert sch.phase_stats(sch.compress_schedule(t_gpipe))["n_phases"] <= 4
+    t_1f1b = compile_schedule("1F1B", 4, 1, 16).table
+    st = sch.phase_stats(sch.compress_schedule(t_1f1b))
+    assert st["n_phases"] < st["n_rows"] // 2
+
+
+def test_phase_replay_degenerate_tables():
+    """Period-free tables (nothing repeats) must still round-trip — every
+    row falls out as a length-1 phase — and tiny tables hit the
+    max_period < 1 edge."""
+    rng = np.random.default_rng(0)
+    # aperiodic: random values with random idle (-1) structure
+    table = rng.integers(0, 50, size=(11, 3, 17)).astype(np.int32)
+    table[rng.random(table.shape) < 0.5] = -1
+    phases = sch.compress_schedule(table)
+    assert np.array_equal(sch.replay_phases(phases), table)
+    assert all(ph.length == 1 for ph in phases)
+    # single-row and two-row tables
+    for rows in (1, 2):
+        t = table[:rows]
+        assert np.array_equal(sch.replay_phases(sch.compress_schedule(t)), t)
+    # corrupted descriptors must not replay silently: the self-check in
+    # compress_schedule guards the pass itself, replay_phases the output
+    bad = [sch.Phase(start=0, period=1, reps=table.shape[0],
+                     base=table[:1], stride=np.zeros_like(table[:1]))]
+    assert not np.array_equal(sch.replay_phases(bad), table)
