@@ -41,10 +41,17 @@ Baseline: the reference's GPipe L8/H8 2-process run on 10-core CPU/gloo =
 1671.32 tok/s (BASELINE.md, notebook cell 25).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}
-— the headline metric up front, the other runs and MFU under "extra".
+— the headline metric up front, the other runs and MFU under "extra",
+plus a structured RunReport manifest (utils.telemetry schema) embedded as
+``extra.run_report`` (or written to ``$BENCH_REPORT_PATH``). Backend init
+is resilient: UNAVAILABLE-style errors retry with backoff and then fall
+back to CPU with ``{"backend_fallback": "cpu"}`` recorded — the bench
+exits 0 even when the accelerator never comes up (see ``_init_backend``).
 """
 
 import json
+import os
+import sys
 import time
 
 import jax
@@ -63,6 +70,56 @@ BASELINE_TOKS_PER_SEC = 1671.32  # GPipe L8/H8 2 procs, reference cell 25
 # trap this repo fell into until round 3)
 _PEAK_FLOPS = {"v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
                "v4": 275e12, "v6": 918e12}
+
+
+def _init_backend(max_retries=None, backoff_s=None) -> dict:
+    """Acquire the accelerator backend with bounded retry, then CPU fallback.
+
+    Round 5's headline finding (BENCH_r05.json): one transient
+    ``UNAVAILABLE: TPU backend setup/compile error`` at the first
+    ``jax.devices()`` killed the whole bench with rc=1 and zero data.
+    Here init errors of that family retry with exponential backoff
+    (``BENCH_BACKEND_RETRIES`` / ``BENCH_BACKEND_BACKOFF_S`` env
+    overrides; defaults 3 x 15 s doubling), and if the accelerator never
+    comes up the bench falls back to ``JAX_PLATFORMS=cpu``, recording
+    ``{"backend_fallback": "cpu"}`` (plus the first error line) in the
+    output — a degraded-but-honest run instead of a stack trace. Non-init
+    errors re-raise unchanged."""
+    if max_retries is None:
+        max_retries = int(os.environ.get("BENCH_BACKEND_RETRIES", "3"))
+    if backoff_s is None:
+        backoff_s = float(os.environ.get("BENCH_BACKEND_BACKOFF_S", "15"))
+    info = {"backend_attempts": 0}
+    delay = backoff_s
+    last_err = None
+    for attempt in range(1, max(max_retries, 1) + 1):
+        info["backend_attempts"] = attempt
+        try:
+            info["backend"] = jax.devices()[0].platform
+            return info
+        except RuntimeError as e:
+            msg = str(e)
+            if ("UNAVAILABLE" not in msg
+                    and "Unable to initialize backend" not in msg):
+                raise
+            last_err = msg
+            print(f"bench: backend init attempt {attempt}/{max_retries} "
+                  f"failed: {msg.splitlines()[0]}", file=sys.stderr,
+                  flush=True)
+            if attempt < max_retries:
+                time.sleep(delay)
+                delay *= 2
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    try:  # drop the failed backend so the cpu client can be created
+        from jax.extend import backend as _jex_backend
+        _jex_backend.clear_backends()
+    except Exception:  # pragma: no cover - version-dependent internals
+        pass
+    info["backend"] = jax.devices()[0].platform
+    info["backend_fallback"] = "cpu"
+    info["backend_error"] = (last_err or "").splitlines()[0][:300]
+    return info
 
 
 def chip_peak_flops() -> float:
@@ -147,19 +204,82 @@ def run_config(cfg, batch_size, seq_length, num_iterations=20,
             "compile_s": round(compile_s, 2)}
 
 
+def _result(headline, extra, n_pipe) -> dict:
+    """Assemble the printed JSON line + the embedded RunReport manifest
+    (same schema as sweep rows and ``fit`` reports — utils.telemetry)."""
+    from distributed_training_with_pipeline_parallelism_tpu.utils.telemetry import (
+        RunReport, validate_report)
+    report = RunReport(name="bench")
+    report.set_meta(n_devices=n_pipe,
+                    **{k: extra[k] for k in
+                       ("backend", "backend_fallback", "backend_attempts",
+                        "backend_error", "chip_peak_flops") if k in extra})
+    for k, v in headline.items():
+        report.gauge(f"headline_{k}", v)
+    for key, row in extra.items():
+        if isinstance(row, dict):
+            report.event("rung", name=key, **row)
+    manifest = report.manifest()
+    validate_report(manifest)
+    path = os.environ.get("BENCH_REPORT_PATH")
+    if path:
+        with open(path, "w") as fh:
+            json.dump(manifest, fh, indent=2)
+            fh.write("\n")
+        extra["run_report_path"] = path
+    else:
+        extra["run_report"] = manifest
+    backward = ("unrolled stored backward" if n_pipe == 1
+                else "rematerializing backward")
+    metric = extra.pop("metric_override", None) or (
+        f"pipeline-executor train-step throughput (GPipe, L8/H8, "
+        f"batch 32, seq 128, 4 microbatches, {n_pipe}-stage, "
+        f"bfloat16, fused-CE, {backward})")
+    return {
+        "metric": metric,
+        "value": headline["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": round(headline["tokens_per_sec"]
+                             / BASELINE_TOKS_PER_SEC, 3),
+        "extra": extra,
+    }
+
+
 def run(num_iterations: int = 20) -> dict:
+    backend = _init_backend()  # retry/backoff, then CPU fallback — never rc=1
+    n_pipe = len(jax.devices())
+    if "backend_fallback" in backend:
+        # Accelerator never came up. The run now exists to prove liveness
+        # and record the fallback, not to publish numbers: the real
+        # headline config (bf16 batch 32 seq 128) takes tens of minutes on
+        # an emulated-bf16 host CPU, so run a small float32 PROXY of the
+        # same executor (tick table, stored backward, 4 microbatches) with
+        # a 2-iteration window, label it, and skip the model ladder.
+        proxy_cfg = dtpp.ModelConfig(n_layers=4, max_seq_len=64)
+        headline = run_config(proxy_cfg, 8, 64, min(num_iterations, 2),
+                              force_tick_executor=True)
+        extra = {"headline": headline, "n_devices": n_pipe, **backend,
+                 "headline_proxy": "cpu fallback proxy: ref_decoder L4/H8 "
+                                   "float32, batch 8, seq 64, 2 iterations "
+                                   "— NOT comparable to the baseline",
+                 "secondary_rungs": {
+                     "skipped": "cpu backend fallback — proxy headline only"},
+                 "metric_override":
+                     f"pipeline-executor liveness proxy (cpu backend "
+                     f"fallback; GPipe L4/H8, batch 8, seq 64, 4 "
+                     f"microbatches, {n_pipe}-stage, float32)"}
+        return _result(headline, extra, n_pipe)
     # reference defaults (dim 768, L8, H8, vocab 10k) in the MXU-native
     # dtype; fused cross-entropy (our Pallas kernel) on: measured ~+1% here
     ref_cfg = dtpp.ModelConfig(dtype="bfloat16", use_fused_xent=True,
                                max_seq_len=128)
-    n_pipe = len(jax.devices())
     # THE headline: the real tick-table executor (stored-activation
     # backward, 4 microbatches) — the machinery this framework exists to
     # provide, not the degenerate fused path
     headline = run_config(ref_cfg, 32, 128, num_iterations,
                           force_tick_executor=True)
     extra = {"headline": headline, "chip_peak_flops": chip_peak_flops(),
-             "n_devices": n_pipe}
+             "n_devices": n_pipe, **backend}
     # secondary configs are isolated: one config's failure (e.g. a device
     # count that does not divide a model's layer count) must not discard
     # the headline result — the reference's own sweep-error contract
@@ -253,18 +373,7 @@ def run(num_iterations: int = 20) -> dict:
         else:
             extra[key] = {"skipped": f"{n_pipe} devices do not divide "
                                      f"{rung_cfg.n_layers} layers"}
-    backward = ("unrolled stored backward" if n_pipe == 1
-                else "rematerializing backward")
-    return {
-        "metric": f"pipeline-executor train-step throughput (GPipe, L8/H8, "
-                  f"batch 32, seq 128, 4 microbatches, {n_pipe}-stage, "
-                  f"bfloat16, fused-CE, {backward})",
-        "value": headline["tokens_per_sec"],
-        "unit": "tokens/sec",
-        "vs_baseline": round(headline["tokens_per_sec"]
-                             / BASELINE_TOKS_PER_SEC, 3),
-        "extra": extra,
-    }
+    return _result(headline, extra, n_pipe)
 
 
 if __name__ == "__main__":

@@ -38,6 +38,7 @@ program over a ``Mesh(('data', 'pipe'))``:
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Tuple
 
 import jax
@@ -233,6 +234,14 @@ def _masked_store(buf, reg, slot):
 
 def _stage_ce(cfg, head_p, embed_p, y, tgt, *, tp_axis, T,
               tp_vocab_parallel, pad_scale, loss_norm):
+    with jax.named_scope("pp/loss"):
+        return _stage_ce_impl(cfg, head_p, embed_p, y, tgt, tp_axis=tp_axis,
+                              T=T, tp_vocab_parallel=tp_vocab_parallel,
+                              pad_scale=pad_scale, loss_norm=loss_norm)
+
+
+def _stage_ce_impl(cfg, head_p, embed_p, y, tgt, *, tp_axis, T,
+                   tp_vocab_parallel, pad_scale, loss_norm):
     """Last-stage cross entropy for one microbatch — plain, ignore-index
     masked, or Megatron vocab-parallel (incl. the tied-embedding vocab-row
     slice). The ONE implementation shared by the training executor's stage
@@ -454,8 +463,10 @@ def _concrete_know(col_vals):
 # table length (tests/test_pipeline.py::test_phase_executor_trace_count).
 _PHASE_TRACE_HOOK = None
 
+logger = logging.getLogger(__name__)
 
-def _phase_compressed_ticks(tick, carry, table, phases):
+
+def _phase_compressed_ticks(tick, carry, table, phases, telemetry=None):
     """Drive a tick program as per-phase ``lax.scan`` s with per-pattern
     specialized bodies — the ``unroll_ticks="phases"`` executor core,
     shared by the training and forward-only programs.
@@ -478,7 +489,15 @@ def _phase_compressed_ticks(tick, carry, table, phases):
     union of the in-phase position 0 and the successor phase's first row —
     conservative is sound, because a ppermute whose arrival no device
     banks is dead (``_masked_store`` skips slot -1), so results stay
-    bit-exact against the plain scan executor."""
+    bit-exact against the plain scan executor.
+
+    ``telemetry`` (a :class:`..utils.telemetry.PipelineTelemetry`, opt-in)
+    brackets each phase's scan with host-timestamp stamps whose probes are
+    scalars drawn from the live carry — dataflow pins phase j's start
+    stamp after phase j-1's work and its end stamp after its own, giving a
+    measured per-phase timeline aligned with the ``phases`` descriptors.
+    When None (default), no callback is emitted at all."""
+    from ..utils import telemetry as _tm
     memo = {}
     n_cols = phases[0].base.shape[-1]
     end_mask = np.full(phases[0].base.shape[1:], -1, np.int32)  # [D, C]
@@ -512,13 +531,19 @@ def _phase_compressed_ticks(tick, carry, table, phases):
             def body(c, xs, _rows=rows_c, _nxts=nxts):
                 if _PHASE_TRACE_HOOK is not None:
                     _PHASE_TRACE_HOOK()
-                for i, (rc, nc) in enumerate(zip(_rows, _nxts)):
-                    c, _ = tick(c, xs[i], concrete=rc, next_concrete=nc)
+                with jax.named_scope("pp/tick_body"):
+                    for i, (rc, nc) in enumerate(zip(_rows, _nxts)):
+                        c, _ = tick(c, xs[i], concrete=rc, next_concrete=nc)
                 return c, None
 
             memo[key] = body
         xs = table[ph.start:ph.start + L].reshape(L // q, q, -1, n_cols)
-        carry, _ = jax.lax.scan(memo[key], carry, xs)
+        if telemetry is not None:
+            telemetry.emit(_tm.PHASE_START, j, _tm.probe_of(carry))
+        with jax.named_scope(f"pp/phase{j}"):
+            carry, _ = jax.lax.scan(memo[key], carry, xs)
+        if telemetry is not None:
+            telemetry.emit(_tm.PHASE_END, j, _tm.probe_of(carry))
     return carry
 
 
@@ -529,6 +554,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                           fsdp: bool = False,
                           remat_backward=None,
                           unroll_ticks=None,
+                          telemetry=None,
                           ) -> Callable[[Pytree, jax.Array, jax.Array],
                                         Tuple[jax.Array, Pytree]]:
     """Build an (unjitted) ``(params, tokens, targets) -> (loss, grads)``
@@ -611,7 +637,18 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
       pays ``tick_executor_overhead`` per tick). Use when iterating
       interactively.
     - ``None`` (auto, default): ``True`` for tables of at most
-      ``_UNROLL_TICKS_LIMIT`` (= 64) rows, ``"phases"`` above.
+      ``_UNROLL_TICKS_LIMIT`` (= 64) rows, ``"phases"`` above (a one-line
+      ``logging.info`` records when that auto phase-compression fires).
+
+    ``telemetry`` (a :class:`..utils.telemetry.PipelineTelemetry`, default
+    None) opts in to a MEASURED tick/phase timeline: the executor plants
+    host-timestamp callbacks at segment boundaries — per phase
+    (``"phases"``), per tick (``True``), or per step (``False``) — and
+    records the compiled table/phases on the collector so its analysis
+    aligns the stamps with the simulated timeline (docs/observability.md).
+    When None the built program contains NO callback (tests assert
+    ``"io_callback" not in str(jaxpr)``) and is bit-identical to an
+    uninstrumented build.
 
     ``fsdp=True`` (pp x fsdp, ZeRO-3 within the pipeline): per-stage layer
     weights live sharded over the 'data' axis (per-leaf weight dim from
@@ -734,8 +771,25 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         use_phase = phase_ok
         use_stored = not phase_ok
     if use_phase:
-        return _make_phase_stored_grad_fn(cfg, mesh, sched, sp_attn_impl,
-                                          tp_vocab_parallel)
+        fn = _make_phase_stored_grad_fn(cfg, mesh, sched, sp_attn_impl,
+                                        tp_vocab_parallel)
+        if telemetry is None:
+            return fn
+        # The phase-stored program differentiates THROUGH its forward tick
+        # scan, so stamps cannot live inside it (io_callback has no
+        # transpose rule); bracket the whole step instead — one measured
+        # whole-table segment, the same shape as the scan executor's
+        # record.
+        from ..utils import telemetry as _tm
+        telemetry.attach(cs.table, None, "phase_stored")
+
+        def instrumented(params, tokens, targets, *rest):
+            telemetry.emit(_tm.STEP_START, 0, _tm.probe_of(tokens))
+            out = fn(params, tokens, targets, *rest)
+            telemetry.emit(_tm.STEP_END, 0, _tm.probe_of(out))
+            return out
+
+        return instrumented
     if unroll_ticks is None:
         # auto: unroll small tables (straight-line specialization, ~2.2 s
         # compile per row); beyond the budget the PHASE-COMPRESSED form —
@@ -743,6 +797,13 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         # cond-dispatched whole-table scan as the default
         unroll_ticks = (True if cs.table.shape[0] <= _UNROLL_TICKS_LIMIT
                         else "phases")
+        if unroll_ticks == "phases":
+            logger.info(
+                "pipeline: %d-row tick table exceeds _UNROLL_TICKS_LIMIT=%d; "
+                "auto-selecting the phase-compressed executor "
+                "(unroll_ticks='phases'; pass unroll_ticks=False for the "
+                "bounded-compile scan form, or True to force full unrolling)",
+                cs.table.shape[0], _UNROLL_TICKS_LIMIT)
     if unroll_ticks not in (True, False, "phases"):
         raise ValueError(f"unroll_ticks must be True, False, 'phases', or "
                          f"None (auto), got {unroll_ticks!r}")
@@ -751,6 +812,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         phases = compress_schedule(cs.table)
     else:
         phases = None
+    if telemetry is not None:
+        telemetry.attach(cs.table, phases,
+                         {True: "unrolled", False: "scan",
+                          "phases": "phases"}[unroll_ticks])
     table = jnp.asarray(cs.table)  # [T, D, N_COLS]
     dtype = jnp.dtype(cfg.dtype)
     fwd_perm = [(i, (i + 1) % D) for i in range(D)]
@@ -814,6 +879,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         head_bundle = (head, embed) if cfg.tie_embeddings else head
 
         def stage_body(layer_p, x, vv=0, mm=0):
+            # XProf legibility: every stage-compute op lands under pp/...
+            with jax.named_scope("pp/stage_body"):
+                return _stage_body_impl(layer_p, x, vv, mm)
+
+        def _stage_body_impl(layer_p, x, vv=0, mm=0):
             """-> (y, aux): aux is the stage's summed routing load-balance
             loss (MoE stages), else a constant 0 that XLA eliminates.
             ``(vv, mm)`` select the dropout stream (train mode): the stack's
@@ -860,15 +930,16 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                                   sp_size=n_seq), zero)
 
         def stage_embed(embed_p, toks, mm=0):
-            embed_p = compute_cast(cfg, embed_p)
-            rng_mb = mb_rng(mm)
-            rng_e = (None if rng_mb is None
-                     else jax.random.fold_in(rng_mb, cfg.n_layers))
-            if sp_axis is None:
-                return embed_apply(cfg, embed_p, toks, rng=rng_e)
-            from .seq_parallel import sp_embed_apply
-            return sp_embed_apply(cfg, embed_p, toks, sp_axis, rng=rng_e,
-                                  sp_size=n_seq)
+            with jax.named_scope("pp/embed"):
+                embed_p = compute_cast(cfg, embed_p)
+                rng_mb = mb_rng(mm)
+                rng_e = (None if rng_mb is None
+                         else jax.random.fold_in(rng_mb, cfg.n_layers))
+                if sp_axis is None:
+                    return embed_apply(cfg, embed_p, toks, rng=rng_e)
+                from .seq_parallel import sp_embed_apply
+                return sp_embed_apply(cfg, embed_p, toks, sp_axis, rng=rng_e,
+                                      sp_size=n_seq)
 
         def select_v(tree, v):
             return jax.tree.map(
@@ -1012,19 +1083,22 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             is dead, so its ppermute is elided (zeros flow instead) — the
             last tick and e.g. GPipe's whole warmup lose their grad-ring
             hops this way."""
-            def hop(send, perm, bank_col):
+            def hop(send, perm, bank_col, name):
                 if next_concrete is not None and (
                         next_concrete[:, bank_col] < 0).all():
                     return jnp.zeros(mb_shape, dtype)
-                return jax.lax.ppermute(send, PIPE_AXIS, perm)
+                with jax.named_scope(name):
+                    return jax.lax.ppermute(send, PIPE_AXIS, perm)
 
-            fr = hop(fwd_send, fwd_perm, COL_STORE_F_SLOT)
-            br = hop(bwd_send, bwd_perm, COL_STORE_B_SLOT)
+            fr = hop(fwd_send, fwd_perm, COL_STORE_F_SLOT, "pp/ring_fwd")
+            br = hop(bwd_send, bwd_perm, COL_STORE_B_SLOT, "pp/ring_bwd")
             if not reverse_routes:
                 return (fr, br)
             return (fr, br,
-                    hop(fwd_send, bwd_perm, COL_STORE_F_NEG_SLOT),
-                    hop(bwd_send, fwd_perm, COL_STORE_B_POS_SLOT))
+                    hop(fwd_send, bwd_perm, COL_STORE_F_NEG_SLOT,
+                        "pp/ring_fwd_rev"),
+                    hop(bwd_send, fwd_perm, COL_STORE_B_POS_SLOT,
+                        "pp/ring_bwd_rev"))
 
         def tick(carry, row_all, concrete=None, next_concrete=None):
             (act_buf, grad_buf, res_bufs, recvs,
@@ -1088,10 +1162,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 def fwd_noop(op):
                     return op, jnp.zeros(mb_shape, dtype)
 
-                (act_buf, res_bufs, loss_acc), fwd_send = run_unit(
-                    fm >= 0, fwd_unit, fwd_noop,
-                    (act_buf, res_bufs, loss_acc),
-                    know=_concrete_know(ccol(COL_FWD_M)))
+                with jax.named_scope("pp/fwd"):
+                    (act_buf, res_bufs, loss_acc), fwd_send = run_unit(
+                        fm >= 0, fwd_unit, fwd_noop,
+                        (act_buf, res_bufs, loss_acc),
+                        know=_concrete_know(ccol(COL_FWD_M)))
             else:
                 def fwd_unit(act_buf):
                     vv, mm = jnp.maximum(fv, 0), jnp.maximum(fm, 0)
@@ -1107,9 +1182,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 def fwd_noop(act_buf):
                     return act_buf, jnp.zeros(mb_shape, dtype)
 
-                act_buf, fwd_send = run_unit(fm >= 0, fwd_unit, fwd_noop,
-                                             act_buf,
-                                             know=_concrete_know(ccol(COL_FWD_M)))
+                with jax.named_scope("pp/fwd"):
+                    act_buf, fwd_send = run_unit(
+                        fm >= 0, fwd_unit, fwd_noop, act_buf,
+                        know=_concrete_know(ccol(COL_FWD_M)))
             if reverse_routes:
                 # same-device hop (vshape's V turning point): the output IS
                 # the next chunk's input — bank it locally, no ring transit
@@ -1139,9 +1215,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 def dgrad_noop(loss_acc):
                     return loss_acc, jnp.zeros(mb_shape, dtype)
 
-                loss_acc, bwd_send = run_unit(bm >= 0, dgrad_unit,
-                                              dgrad_noop, loss_acc,
-                                              know=_concrete_know(ccol(COL_BWD_M)))
+                with jax.named_scope("pp/bwd_dgrad"):
+                    loss_acc, bwd_send = run_unit(
+                        bm >= 0, dgrad_unit, dgrad_noop, loss_acc,
+                        know=_concrete_know(ccol(COL_BWD_M)))
                 if reverse_routes:
                     grad_buf = store(grad_buf, bwd_send, COL_BWD_LOCAL_SLOT)
 
@@ -1181,10 +1258,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                         lambda: g_embed)
                     return (g_layers, g_embed, g_head)
 
-                (g_layers, g_embed, g_head) = run_unit(
-                    wm >= 0, wgrad_unit, lambda op: op,
-                    (g_layers, g_embed, g_head),
-                    know=_concrete_know(ccol(COL_W_M)))
+                with jax.named_scope("pp/wgrad"):
+                    (g_layers, g_embed, g_head) = run_unit(
+                        wm >= 0, wgrad_unit, lambda op: op,
+                        (g_layers, g_embed, g_head),
+                        know=_concrete_know(ccol(COL_W_M)))
 
                 return (act_buf, grad_buf, res_bufs,
                         transfers(fwd_send, bwd_send, next_concrete),
@@ -1297,10 +1375,12 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             def bwd_noop(operand):
                 return operand, jnp.zeros(mb_shape, dtype)
 
-            (g_layers, g_embed, g_head, loss_acc), bwd_send = run_unit(
-                bm >= 0, bwd_unit_stored if use_stored else bwd_unit_remat,
-                bwd_noop, (g_layers, g_embed, g_head, loss_acc),
-                know=_concrete_know(ccol(COL_BWD_M)))
+            with jax.named_scope("pp/bwd"):
+                (g_layers, g_embed, g_head, loss_acc), bwd_send = run_unit(
+                    bm >= 0,
+                    bwd_unit_stored if use_stored else bwd_unit_remat,
+                    bwd_noop, (g_layers, g_embed, g_head, loss_acc),
+                    know=_concrete_know(ccol(COL_BWD_M)))
             if reverse_routes:
                 grad_buf = store(grad_buf, bwd_send, COL_BWD_LOCAL_SLOT)
 
@@ -1325,22 +1405,34 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         if unroll_ticks == "phases":
             # phase-compressed: one specialized scan body per unique row
             # pattern, each phase driven as a lax.scan over its real rows
-            carry = _phase_compressed_ticks(tick, carry0, table, phases)
+            carry = _phase_compressed_ticks(tick, carry0, table, phases,
+                                            telemetry=telemetry)
         elif unroll_ticks:
             # straight-line tick program: the Python loop IS the schedule,
             # each tick specialized against its concrete table row block
             # (cond/ppermute/store elision — see the tick helpers above)
             carry = carry0
             n_rows = cs.table.shape[0]
+            if telemetry is not None:
+                from ..utils import telemetry as _tm
+                telemetry.emit(_tm.STEP_START, 0, _tm.probe_of(carry))
             # after the final tick nothing banks: an all-dead pseudo-row
             # elides the last hops (None means "no knowledge" — scan path)
             end_row = np.full_like(cs.table[0], -1)
             for t in range(n_rows):
                 nxt = cs.table[t + 1] if t + 1 < n_rows else end_row
-                carry, _ = tick(carry, table[t], concrete=cs.table[t],
-                                next_concrete=nxt)
+                with jax.named_scope(f"pp/tick{t:03d}"):
+                    carry, _ = tick(carry, table[t], concrete=cs.table[t],
+                                    next_concrete=nxt)
+                if telemetry is not None:
+                    telemetry.emit(_tm.TICK, t, _tm.probe_of(carry))
         else:
+            if telemetry is not None:
+                from ..utils import telemetry as _tm
+                telemetry.emit(_tm.STEP_START, 0, _tm.probe_of(carry0))
             carry, _ = jax.lax.scan(tick, carry0, table)
+            if telemetry is not None:
+                telemetry.emit(_tm.STEP_END, 0, _tm.probe_of(carry))
         (_, _, _, _, g_layers, g_embed, g_head, loss_acc) = carry
 
         # Reductions: loss lives on the last stage only; embed/head grads on
@@ -1472,6 +1564,7 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                        fsdp: bool = False,
                        remat_backward=None,
                        unroll_ticks=None,
+                       telemetry=None,
                        ) -> Callable[[Pytree, jax.Array, jax.Array],
                                      Tuple[jax.Array, Pytree]]:
     """Jitted ``(params, tokens, targets) -> (loss, grads)`` pipeline step.
@@ -1496,12 +1589,22 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     scales with UNIQUE tick patterns — O(1) in M for steady-state 1F1B),
     ``False`` is the bounded-compile cond-dispatched scan (~7 s), and
     ``None`` (default) auto-selects ``True`` up to ``_UNROLL_TICKS_LIMIT``
-    rows and ``"phases"`` beyond.
+    rows and ``"phases"`` beyond — a one-line ``logging.info`` announces
+    when a large table triggers that auto phase-compression. If compile
+    time still hurts (or you are bisecting an executor-formulation
+    difference), the ESCAPE HATCHES are explicit ``unroll_ticks=False``
+    (bounded-compile scan) or ``unroll_ticks="phases"`` — both run the
+    identical tick program, bit-exact against the unrolled form.
+
+    ``telemetry`` (opt-in ``utils.telemetry.PipelineTelemetry``) records a
+    measured tick/phase timeline; None (default) compiles zero
+    instrumentation (see :func:`make_pipeline_grad_fn`).
     """
     return jax.jit(make_pipeline_grad_fn(
         cfg, mesh, sched, force_tick_executor=force_tick_executor, moe=moe,
         sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel,
-        fsdp=fsdp, remat_backward=remat_backward, unroll_ticks=unroll_ticks))
+        fsdp=fsdp, remat_backward=remat_backward, unroll_ticks=unroll_ticks,
+        telemetry=telemetry))
 
 
 def fsdp_shard_params(params: Pytree, cfg: ModelConfig, mesh: Mesh,

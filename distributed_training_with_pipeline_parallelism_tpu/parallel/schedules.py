@@ -1042,6 +1042,49 @@ def phase_stats(phases: Sequence[Phase]) -> Dict[str, int]:
     }
 
 
+def phase_spans(phases: Sequence[Phase]) -> List[Tuple[int, int]]:
+    """``[(start_tick, n_ticks)]`` per phase — the tick-axis alignment a
+    measured per-phase timeline (``utils.telemetry``) is interpreted on.
+    Spans tile ``[0, makespan)`` contiguously (compression invariant)."""
+    return [(p.start, p.length) for p in phases]
+
+
+def table_unit_activity(table: np.ndarray) -> np.ndarray:
+    """Classify every (tick, device) cell of a tick table as F/B/W/idle.
+
+    Returns ``[T, D, 4]`` 0/1 with the last axis ordered (F, B, W, idle).
+    Works on both the 4-column forward-only table (col 2 is the forward
+    microbatch) and the >=13-column training table (``COL_FWD_M`` /
+    ``COL_BWD_M`` / ``COL_W_M``). A cell doing several units in one tick
+    (e.g. B and W fused on non-split schedules' backward) counts each
+    active op; ``idle`` is set only when no unit runs. This is the
+    attribution mask that maps measured segment durations onto stages and
+    ops (the measured counterpart of :func:`simulated_bubble`'s weights).
+    """
+    table = np.asarray(table)
+    if table.ndim != 3:
+        raise ScheduleError(f"expected [T, D, n_cols] table, got shape "
+                            f"{table.shape}")
+    n_cols = table.shape[2]
+    f = table[:, :, COL_FWD_M] >= 0 if n_cols > COL_FWD_M else (
+        table[:, :, n_cols - 2] >= 0)
+    b = (table[:, :, COL_BWD_M] >= 0 if n_cols > COL_BWD_M
+         else np.zeros(table.shape[:2], bool))
+    w = (table[:, :, COL_W_M] >= 0 if n_cols > COL_W_M
+         else np.zeros(table.shape[:2], bool))
+    idle = ~(f | b | w)
+    return np.stack([f, b, w, idle], axis=-1).astype(np.int64)
+
+
+def phase_unit_activity(phases: Sequence[Phase]) -> np.ndarray:
+    """Per-phase, per-device tick counts in (F, B, W, idle): ``[n_phases,
+    D, 4]``. The weights that spread one phase's *measured* duration over
+    stages and ops — see ``utils.telemetry.PipelineTelemetry
+    .stage_breakdown``."""
+    return np.stack([table_unit_activity(rows_of(p)).sum(axis=0)
+                     for p in phases])
+
+
 # ---------------------------------------------------------------------------
 # Bubble analytics
 # ---------------------------------------------------------------------------

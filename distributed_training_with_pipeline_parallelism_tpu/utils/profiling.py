@@ -40,12 +40,27 @@ import jax
 @contextlib.contextmanager
 def trace(log_dir: str):
     """Profile a block: ``with trace('/tmp/prof'): step(...)`` then inspect
-    in TensorBoard/XProf."""
+    in TensorBoard/XProf. The pipeline executors label their compute with
+    ``pp/...`` named scopes (``pp/phase3``, ``pp/fwd``, ``pp/ring_bwd``,
+    ...), so trace rows group by schedule structure — see
+    docs/observability.md for the reading guide."""
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Label HOST-side spans of a traced block in XProf:
+    ``with annotate("step3"): step(...)``. Complements the executors'
+    ``jax.named_scope`` labels, which name DEVICE-side ops at trace time:
+    ``TraceAnnotation`` marks wall-clock regions of the host timeline
+    (e.g. which bench rung or train step issued the work). No-op cost when
+    no profiler session is active."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
 
 
 def _time_fn(fn, *args, iters: int = 5, warmup: int = 2) -> float:

@@ -41,9 +41,17 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                        arch: str = "ref_decoder",
                        dtype: str = "float32",
                        remat_backward=None,
-                       unroll_ticks=None) -> Dict[str, float]:
+                       unroll_ticks=None,
+                       report_dir: Optional[str] = None) -> Dict[str, float]:
     """Run one pipeline experiment; returns the reference's metrics dict plus
     bubble analytics, or ``{"error": ...}`` on failure.
+
+    ``report_dir``: also emit the row as a structured
+    :class:`.telemetry.RunReport` manifest — config/mesh/schedule meta,
+    the metrics as gauges, timed-loop timers — appended as one JSON line
+    to ``{report_dir}/sweep_reports.jsonl`` (validated against the shared
+    schema before writing), so sweep rows, ``fit`` runs and ``bench.py``
+    all speak the same report format (docs/observability.md).
 
     Self-describing columns (so the artifact cannot be misread without its
     docs): ``backward_policy`` records which backward the executor compiled
@@ -85,8 +93,18 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
         tokens = jax.random.randint(kx, (batch_size, seq_length), 0, vocab_size)
         targets = jax.random.randint(ky, (batch_size, seq_length), 0, vocab_size)
 
+        report = None
+        if report_dir is not None:
+            from .telemetry import RunReport
+            report = RunReport(name=f"sweep_L{n_layers}_H{n_heads}_"
+                                    f"D{num_devices}_{schedule_type}")
+            report.set_meta(config=cfg, schedule=sched,
+                            mesh_shape=dict(mesh.shape),
+                            batch_size=batch_size, seq_length=seq_length,
+                            backend=jax.devices()[0].platform)
         metrics = run_train_iterations(step, params, tokens, targets,
-                                       num_iterations=num_iterations)
+                                       num_iterations=num_iterations,
+                                       report=report)
         cs = compile_schedule(schedule_type, num_devices, n_virtual,
                               n_microbatches)
         # bubble_simulated uses the weights of the backward the executor
@@ -120,6 +138,19 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                 else ("unrolled" if cs.table.shape[0] <= 64 else "phases")),
             "host_serialized": jax.devices()[0].platform == "cpu",
         })
+        if report is not None:
+            import json
+            import os
+
+            from .telemetry import validate_report
+            for k, v in metrics.items():
+                report.gauge(k, v)
+            manifest = report.manifest()
+            validate_report(manifest)
+            os.makedirs(report_dir, exist_ok=True)
+            with open(os.path.join(report_dir, "sweep_reports.jsonl"),
+                      "a") as fh:
+                fh.write(json.dumps(manifest) + "\n")
         return metrics
     except Exception as e:  # same catch-all contract as the reference worker
         traceback.print_exc()

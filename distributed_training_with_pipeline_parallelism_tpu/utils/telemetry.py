@@ -1,0 +1,444 @@
+"""Pipeline telemetry: measured tick/phase timelines + structured run reports.
+
+The reference's only instrumentation is ``time.time()`` around the timed
+loop (SURVEY.md §5). This module makes the *measured* counterpart of the
+simulated tick timeline (``schedules.simulated_bubble``, ``replay_phases``)
+first-class, following arXiv:2605.24006's argument that the tick table is
+the right axis for evaluation and arXiv:2401.10241's that per-stage idle
+time should be measured, not inferred.
+
+Two pieces:
+
+- :class:`PipelineTelemetry` — an opt-in recorder the executors in
+  ``parallel.pipeline`` stamp from inside the traced program via
+  ``jax.experimental.io_callback``. Off by default: when no collector is
+  passed, the executor emits **no** callback at trace time (the jaxpr is
+  bit-identical to an uninstrumented build — tests assert ``"io_callback"
+  not in str(jaxpr)``). When enabled, each phase-scan segment (phase
+  executor), each tick (unrolled executor), or the whole table scan
+  records host-side ``perf_counter`` stamps, keyed so the analysis side
+  can reassemble a measured timeline aligned tick-for-tick with
+  ``schedules.compress_schedule``'s phases.
+
+- :class:`RunReport` — a structured run recorder (counters, timers,
+  gauges, JSONL event stream + a single JSON manifest carrying config,
+  mesh shape, schedule, phase stats, compile time and jax/jaxlib
+  versions) with a dependency-free :func:`validate_report` so sweeps,
+  ``fit`` and ``bench.py`` all emit the same schema instead of ad-hoc
+  dicts.
+
+Stamp semantics under SPMD: ``io_callback`` inside ``shard_map`` fires
+once **per device** (a 4-device mesh emits 4 stamps per logical event), so
+every analysis groups events by ``(kind, index)`` and takes ``min`` of
+start stamps / ``max`` of end stamps — the earliest entry and the last
+straggler bound the segment. Each stamp carries a scalar *probe* derived
+from the executor's carry so plain dataflow (not effect ordering) pins the
+stamp after the computation it closes over; callbacks are emitted
+unordered, which keeps the program legal on backends where ordered
+effects constrain control flow.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+SCHEMA_VERSION = 1
+
+# Event kinds carried in the first operand of every stamp.
+STEP_START, PHASE_START, PHASE_END, TICK, STEP_END = 0, 1, 2, 3, 4
+_KIND_NAMES = {STEP_START: "step_start", PHASE_START: "phase_start",
+               PHASE_END: "phase_end", TICK: "tick", STEP_END: "step_end"}
+
+
+# ---------------------------------------------------------------------------
+# Measured timelines
+# ---------------------------------------------------------------------------
+
+
+def probe_of(carry) -> Any:
+    """Smallest array leaf of an executor carry, as the data-dependence
+    anchor of a stamp: the callback consumes this value, so XLA cannot
+    float the stamp before the computation that produced the carry (nor
+    drop it). Both executors' carries end in the scalar ``loss_acc``,
+    which this picks."""
+    import jax
+    leaves = [x for x in jax.tree_util.tree_leaves(carry)
+              if hasattr(x, "size")]
+    x = min(leaves, key=lambda v: v.size)
+    return x.ravel()[0]
+
+
+class PipelineTelemetry:
+    """Host-side collector for executor timing stamps.
+
+    Build-time: ``make_pipeline_grad_fn(..., telemetry=tel)`` calls
+    :meth:`attach` with the compiled tick table, its phases and the tick
+    executor it resolved, then plants :meth:`emit` calls at segment
+    boundaries. Run-time: each executed instrumented step appends
+    ``(kind, index, t_host)`` rows here (once per device). Analysis:
+    :meth:`timeline` / :meth:`stage_breakdown` / :meth:`report` after at
+    least one step has been forced to completion
+    (``utils.metrics.force_completion``).
+    """
+
+    def __init__(self) -> None:
+        self.events: List[Tuple[int, int, float]] = []
+        self.table: Optional[np.ndarray] = None
+        self.phases = None  # Tuple[schedules.Phase, ...] | None
+        self.executor: Optional[str] = None
+
+    # -- build-time -----------------------------------------------------
+
+    def attach(self, table: np.ndarray, phases, executor: str) -> None:
+        """Record the schedule the instrumented program was built against
+        (the alignment target every measured stamp is interpreted on)."""
+        self.table = np.asarray(table)
+        self.phases = tuple(phases) if phases is not None else None
+        self.executor = executor
+
+    def emit(self, kind: int, index: int, probe) -> None:
+        """Plant one stamp in the traced program. Called during tracing by
+        the executors; ``probe`` is a scalar from the live carry (see
+        :func:`probe_of`)."""
+        import jax.numpy as jnp
+        from jax.experimental import io_callback
+        io_callback(self._stamp, None, jnp.int32(kind), jnp.int32(index),
+                    probe, ordered=False)
+
+    # -- run-time host target -------------------------------------------
+
+    def _stamp(self, kind, index, _probe) -> None:
+        self.events.append((int(kind), int(index), time.perf_counter()))
+
+    def reset(self) -> None:
+        """Drop recorded events (keep the attached schedule) — call between
+        steps when only the last step's timeline is wanted."""
+        self.events = []
+
+    # -- analysis -------------------------------------------------------
+
+    def spans(self) -> Dict[Tuple[int, int], Tuple[float, float, int]]:
+        """Group per-device stamps: ``(kind, index) -> (t_min, t_max, n)``."""
+        out: Dict[Tuple[int, int], Tuple[float, float, int]] = {}
+        for kind, idx, t in self.events:
+            key = (kind, idx)
+            if key in out:
+                lo, hi, n = out[key]
+                out[key] = (min(lo, t), max(hi, t), n + 1)
+            else:
+                out[key] = (t, t, 1)
+        return out
+
+    def timeline(self) -> List[Dict[str, Any]]:
+        """The measured timeline, one record per instrumented segment.
+
+        Phase executor: one record per :class:`~..parallel.schedules.Phase`
+        (``phase``, ``start_tick``, ``n_ticks``, ``period``, ``reps``,
+        ``duration_s``) — directly comparable to ``replay_phases``' tick
+        spans. Unrolled executor: one record per tick. Scan executor: a
+        single whole-table record. Durations take the earliest start stamp
+        to the latest end stamp across devices (lockstep SPMD: the
+        straggler defines the segment).
+        """
+        if not self.events:
+            raise ValueError(
+                "no telemetry events recorded — run (and force completion "
+                "of) at least one instrumented step first")
+        spans = self.spans()
+        records: List[Dict[str, Any]] = []
+        if self.executor == "phases":
+            if self.phases is None:
+                raise ValueError("phase timeline requested but no phases "
+                                 "attached (was attach() called?)")
+            for j, ph in enumerate(self.phases):
+                start = spans.get((PHASE_START, j))
+                end = spans.get((PHASE_END, j))
+                if start is None or end is None:
+                    raise ValueError(f"phase {j} missing stamps (start="
+                                     f"{start}, end={end}) — incomplete run")
+                dur = max(end[1] - start[0], 0.0)
+                records.append({
+                    "kind": "phase", "phase": j, "start_tick": ph.start,
+                    "n_ticks": ph.length, "period": ph.period,
+                    "reps": ph.reps, "t0": start[0], "t1": end[1],
+                    "duration_s": dur,
+                })
+        elif self.executor == "unrolled":
+            t0 = spans.get((STEP_START, 0))
+            ticks = sorted(i for k, i in spans if k == TICK)
+            prev = t0[0] if t0 is not None else None
+            for t in ticks:
+                _, hi, _ = spans[(TICK, t)]
+                records.append({
+                    "kind": "tick", "tick": t, "start_tick": t, "n_ticks": 1,
+                    "t1": hi,
+                    "duration_s": (max(hi - prev, 0.0)
+                                   if prev is not None else None),
+                })
+                prev = hi
+        else:  # whole-table scan: one segment
+            start = spans.get((STEP_START, 0))
+            end = spans.get((STEP_END, 0))
+            if start is None or end is None:
+                raise ValueError("scan executor run missing step start/end "
+                                 "stamps — incomplete run")
+            n = self.table.shape[0] if self.table is not None else 0
+            records.append({
+                "kind": "step", "start_tick": 0, "n_ticks": n,
+                "t0": start[0], "t1": end[1],
+                "duration_s": max(end[1] - start[0], 0.0),
+            })
+        return records
+
+    def stage_breakdown(self) -> Dict[str, Any]:
+        """Per-stage measured F/B/W/idle attribution and bubble.
+
+        Each segment's measured duration is spread uniformly over its
+        ticks, and each (device, tick) is classified by the tick table's
+        op columns (``schedules.table_unit_activity``). That uniform
+        spread is an attribution model — within a phase the executor runs
+        a single fused scan, so per-tick variation inside a segment is
+        not observable; across segments (where schedules actually differ)
+        the attribution is measured. ``bubble_measured`` per stage is its
+        idle share of the measured makespan, the measured counterpart of
+        ``simulated_bubble``'s per-device fractions."""
+        from ..parallel.schedules import table_unit_activity
+        if self.table is None:
+            raise ValueError("no tick table attached")
+        activity = table_unit_activity(self.table)  # [T, D, 4] 0/1
+        D = activity.shape[1]
+        seconds = np.zeros((D, 4))
+        total = 0.0
+        for rec in self.timeline():
+            dur = rec.get("duration_s")
+            if dur is None:
+                continue
+            total += dur
+            t0, n = rec["start_tick"], rec["n_ticks"]
+            if n <= 0:
+                continue
+            per_tick = dur / n
+            seconds += activity[t0:t0 + n].sum(axis=0) * per_tick
+        per_stage = []
+        for d in range(D):
+            f_s, b_s, w_s, idle_s = (float(x) for x in seconds[d])
+            per_stage.append({
+                "device": d, "f_s": f_s, "b_s": b_s, "w_s": w_s,
+                "idle_s": idle_s,
+                "bubble_measured": idle_s / total if total > 0 else 0.0,
+            })
+        busy = seconds[:, :3].sum()
+        split = (seconds[:, :3].sum(axis=0) / busy if busy > 0
+                 else np.zeros(3))
+        return {
+            "total_s": total,
+            "per_stage": per_stage,
+            "f_frac": float(split[0]), "b_frac": float(split[1]),
+            "w_frac": float(split[2]),
+            "bubble_measured_mean": float(
+                np.mean([s["bubble_measured"] for s in per_stage])),
+        }
+
+    def report(self) -> Dict[str, Any]:
+        """The telemetry section embedded in :class:`RunReport` manifests."""
+        out: Dict[str, Any] = {"executor": self.executor,
+                               "n_events": len(self.events)}
+        if self.phases is not None:
+            from ..parallel.schedules import phase_stats
+            out["phase_stats"] = phase_stats(self.phases)
+        if self.events:
+            out["timeline"] = self.timeline()
+            if self.table is not None:
+                out["stage_breakdown"] = self.stage_breakdown()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Structured run reports
+# ---------------------------------------------------------------------------
+
+
+class RunReport:
+    """Counters / timers / gauges + JSONL events + a single JSON manifest.
+
+    One instance per run (a ``fit`` call, a sweep row, a bench
+    invocation). With ``out_dir`` set, :meth:`event` streams to
+    ``events.jsonl`` as it happens (crash-safe partial record) and
+    :meth:`write` drops ``report.json``; without it everything stays
+    in-memory and :meth:`manifest` returns the same schema for embedding.
+    """
+
+    def __init__(self, out_dir: Optional[str] = None,
+                 name: str = "run") -> None:
+        import jax
+        import jaxlib
+        self.meta: Dict[str, Any] = {
+            "name": name,
+            "created_unix": time.time(),
+            "jax_version": jax.__version__,
+            "jaxlib_version": getattr(jaxlib, "__version__", "unknown"),
+        }
+        self.counters: Dict[str, int] = {}
+        self.gauges: Dict[str, Any] = {}
+        self.timers: Dict[str, float] = {}
+        self.events: List[Dict[str, Any]] = []
+        self.telemetry: Optional[Dict[str, Any]] = None
+        self.out_dir = out_dir
+        self._events_fh = None
+        if out_dir is not None:
+            os.makedirs(out_dir, exist_ok=True)
+
+    # -- recording ------------------------------------------------------
+
+    def set_meta(self, **fields: Any) -> None:
+        """Merge run-identifying fields (config, mesh_shape, schedule,
+        phase_stats, backend, ...) into the manifest's ``meta`` block."""
+        self.meta.update(fields)
+
+    def count(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + int(n)
+
+    def gauge(self, name: str, value: Any) -> None:
+        self.gauges[name] = value
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        """Accumulating wall-clock timer: ``with report.timer("compile_s"):``."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timers[name] = (self.timers.get(name, 0.0)
+                                 + time.perf_counter() - t0)
+
+    def event(self, kind: str, **fields: Any) -> None:
+        """Append one timestamped event; streamed to ``events.jsonl`` when
+        the report has an output directory."""
+        row = {"t": time.time(), "kind": kind, **fields}
+        self.events.append(row)
+        if self.out_dir is not None:
+            if self._events_fh is None:
+                self._events_fh = open(
+                    os.path.join(self.out_dir, "events.jsonl"), "a")
+            self._events_fh.write(json.dumps(row, default=_jsonable) + "\n")
+            self._events_fh.flush()
+
+    def attach_telemetry(self, telemetry: PipelineTelemetry) -> None:
+        """Embed a measured-timeline section (:meth:`PipelineTelemetry.report`)."""
+        self.telemetry = telemetry.report()
+
+    # -- output ---------------------------------------------------------
+
+    def manifest(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "schema_version": SCHEMA_VERSION,
+            "meta": _jsonable(self.meta),
+            "counters": dict(self.counters),
+            "gauges": _jsonable(self.gauges),
+            "timers": dict(self.timers),
+            "n_events": len(self.events),
+        }
+        if self.out_dir is not None:
+            out["events_path"] = os.path.join(self.out_dir, "events.jsonl")
+        else:
+            out["events"] = _jsonable(self.events)
+        if self.telemetry is not None:
+            out["telemetry"] = _jsonable(self.telemetry)
+        return out
+
+    def write(self, path: Optional[str] = None) -> Dict[str, Any]:
+        """Validate + write the manifest (``report.json`` under ``out_dir``
+        by default); returns the manifest dict."""
+        m = self.manifest()
+        validate_report(m)
+        if path is None:
+            if self.out_dir is None:
+                raise ValueError("RunReport has no out_dir; pass a path")
+            path = os.path.join(self.out_dir, "report.json")
+        with open(path, "w") as fh:
+            json.dump(m, fh, indent=2, default=_jsonable)
+            fh.write("\n")
+        if self._events_fh is not None:
+            self._events_fh.close()
+            self._events_fh = None
+        return m
+
+
+def _jsonable(x: Any) -> Any:
+    """Best-effort conversion to JSON-serializable primitives (numpy
+    scalars/arrays, dataclass-likes, tuples)."""
+    if isinstance(x, dict):
+        return {str(k): _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    if isinstance(x, (np.integer,)):
+        return int(x)
+    if isinstance(x, (np.floating,)):
+        return float(x)
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    if isinstance(x, (str, int, float, bool)) or x is None:
+        return x
+    if hasattr(x, "__dataclass_fields__"):
+        import dataclasses
+        return _jsonable(dataclasses.asdict(x))
+    return str(x)
+
+
+def validate_report(manifest: Dict[str, Any]) -> None:
+    """Schema check for a RunReport manifest (hand-rolled: the container
+    has no jsonschema). Raises ``ValueError`` on the first violation."""
+    def fail(msg: str):
+        raise ValueError(f"invalid run report: {msg}")
+
+    if not isinstance(manifest, dict):
+        fail("manifest must be a dict")
+    if manifest.get("schema_version") != SCHEMA_VERSION:
+        fail(f"schema_version must be {SCHEMA_VERSION}, got "
+             f"{manifest.get('schema_version')!r}")
+    meta = manifest.get("meta")
+    if not isinstance(meta, dict):
+        fail("meta must be a dict")
+    for key in ("name", "jax_version", "jaxlib_version"):
+        if not isinstance(meta.get(key), str):
+            fail(f"meta.{key} must be a string")
+    if not isinstance(meta.get("created_unix"), (int, float)):
+        fail("meta.created_unix must be a number")
+    counters = manifest.get("counters")
+    if not isinstance(counters, dict) or not all(
+            isinstance(v, int) for v in counters.values()):
+        fail("counters must be a dict of ints")
+    if not isinstance(manifest.get("gauges"), dict):
+        fail("gauges must be a dict")
+    timers = manifest.get("timers")
+    if not isinstance(timers, dict) or not all(
+            isinstance(v, (int, float)) for v in timers.values()):
+        fail("timers must be a dict of numbers")
+    if not isinstance(manifest.get("n_events"), int):
+        fail("n_events must be an int")
+    events = manifest.get("events")
+    if events is not None:
+        if not isinstance(events, list):
+            fail("events must be a list")
+        for row in events:
+            if not isinstance(row, dict) or not isinstance(
+                    row.get("kind"), str) or not isinstance(
+                    row.get("t"), (int, float)):
+                fail("each event needs a str 'kind' and numeric 't'")
+    elif not isinstance(manifest.get("events_path"), str):
+        fail("manifest needs either inline 'events' or an 'events_path'")
+    tel = manifest.get("telemetry")
+    if tel is not None:
+        if not isinstance(tel, dict):
+            fail("telemetry must be a dict")
+        if "timeline" in tel:
+            if not isinstance(tel["timeline"], list) or not all(
+                    isinstance(r, dict) and "duration_s" in r
+                    and "n_ticks" in r for r in tel["timeline"]):
+                fail("telemetry.timeline rows need duration_s and n_ticks")

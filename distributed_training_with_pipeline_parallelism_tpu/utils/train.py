@@ -9,6 +9,7 @@ the same pipeline gradients with an optax optimizer under a single jit here.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import math
@@ -33,7 +34,7 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     sp_attn_impl: str = "ring",
                     tp_vocab_parallel: bool = False,
                     fsdp: bool = False, remat_backward=None,
-                    unroll_ticks=None,
+                    unroll_ticks=None, telemetry=None,
                     ) -> Callable[[Pytree, Any, jax.Array, jax.Array],
                                   Tuple[Pytree, Any, jax.Array]]:
     """Jitted ``(params, opt_state, tokens, targets) ->
@@ -50,12 +51,16 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     ``unroll_ticks`` picks the tick-executor formulation (None = auto:
     unrolled up to 64 table rows, phase-compressed scan beyond; also
     ``True``/``False``/``"phases"`` — compile-time economics in
-    :func:`..parallel.pipeline.make_pipeline_grad_fn`)."""
+    :func:`..parallel.pipeline.make_pipeline_grad_fn`). ``telemetry``
+    (opt-in ``utils.telemetry.PipelineTelemetry``) records a measured
+    tick/phase timeline for the grad program; None (default) compiles
+    zero instrumentation."""
     grad_fn = make_pipeline_grad_fn(cfg, mesh, sched, moe=moe,
                                     sp_attn_impl=sp_attn_impl,
                                     tp_vocab_parallel=tp_vocab_parallel,
                                     fsdp=fsdp, remat_backward=remat_backward,
-                                    unroll_ticks=unroll_ticks)
+                                    unroll_ticks=unroll_ticks,
+                                    telemetry=telemetry)
 
     if cfg.dropout > 0.0:
         # train-mode dropout: the step takes a per-step PRNG key
@@ -225,7 +230,9 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         eval_every: int = 0, eval_batches: int = 8,
         profile_dir: Optional[str] = None,
         profile_steps: Tuple[int, int] = (2, 5),
-        grad_accum: int = 1):
+        grad_accum: int = 1,
+        report_dir: Optional[str] = None,
+        telemetry=None):
     """Training loop over a ``(tokens, targets)`` iterator.
 
     Returns (params, list of (step, loss)). The data contract matches the
@@ -261,6 +268,15 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
       schedule already performs. k accumulated steps on batch B step the
       optimizer exactly as one step on batch k*B would. ``num_steps``
       counts data batches, so optimizer updates = num_steps / k.
+    - ``report_dir``: write a structured :class:`.telemetry.RunReport` —
+      ``events.jsonl`` streamed as the run progresses (every train-log and
+      eval point) plus a final ``report.json`` manifest (config, mesh
+      shape, schedule, compile_s, jax/jaxlib versions, final metrics) in
+      the schema ``telemetry.validate_report`` checks — the same schema
+      sweep rows and ``bench.py`` emit (docs/observability.md).
+    - ``telemetry``: opt-in ``telemetry.PipelineTelemetry`` wired into the
+      compiled step (measured tick/phase timeline); its analysis is
+      embedded in the report manifest when ``report_dir`` is also set.
     """
     if optimizer is None:
         # the LR schedule advances once per OPTIMIZER update, which under
@@ -273,7 +289,17 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                               sp_attn_impl=sp_attn_impl,
                               tp_vocab_parallel=tp_vocab_parallel,
                               fsdp=fsdp, remat_backward=remat_backward,
-                              unroll_ticks=unroll_ticks)
+                              unroll_ticks=unroll_ticks,
+                              telemetry=telemetry)
+    report = None
+    if report_dir is not None:
+        from .telemetry import RunReport
+        report = RunReport(out_dir=report_dir, name="fit")
+        report.set_meta(config=dataclasses.asdict(cfg),
+                        schedule=dataclasses.asdict(sched),
+                        mesh_shape=dict(mesh.shape),
+                        num_steps=num_steps, grad_accum=grad_accum,
+                        backend=jax.devices()[0].platform)
     if fsdp and zero1:
         raise ValueError("fsdp already shards optimizer state (ZeRO-3 "
                          "subsumes ZeRO-1) — drop --zero1")
@@ -334,6 +360,8 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         if metrics_path:
             with open(metrics_path, "a") as f:
                 f.write(json.dumps({"step": i, **m}) + "\n")
+        if report is not None:
+            report.event("eval", step=i, **m)
         return m
 
     history = []
@@ -355,12 +383,20 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                 if verbose:
                     print(f"profile trace written to {profile_dir}", flush=True)
         tokens, targets = next(data)
-        if drop_key is not None:
-            params, opt_state, loss = step_fn(
-                params, opt_state, tokens, targets,
-                jax.random.fold_in(drop_key, i))
-        else:
-            params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
+        # first executed step = trace + compile + run; the report's
+        # compile_s timer brackets it (forced, so the timer is honest)
+        first = report is not None and i == start_step
+        with (report.timer("compile_s") if first
+              else contextlib.nullcontext()):
+            if drop_key is not None:
+                params, opt_state, loss = step_fn(
+                    params, opt_state, tokens, targets,
+                    jax.random.fold_in(drop_key, i))
+            else:
+                params, opt_state, loss = step_fn(params, opt_state,
+                                                  tokens, targets)
+            if first:
+                jax.block_until_ready(loss)
         window_tokens += tokens.shape[0] * tokens.shape[1]
         if i % log_every == 0 or i == num_steps - 1:
             loss_f = float(loss)  # device sync: closes the timing window
@@ -374,6 +410,10 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                         "step": i, "loss": loss_f,
                         "tokens_per_sec": round(window_tokens / elapsed, 2),
                         "elapsed_s": round(elapsed, 4)}) + "\n")
+            if report is not None:
+                report.event("train_log", step=i, loss=loss_f,
+                             tokens_per_sec=round(window_tokens / elapsed, 2),
+                             elapsed_s=round(elapsed, 4))
             window_start = time.perf_counter()
             window_tokens = 0
         if (eval_fn is not None and (i + 1) % eval_every == 0
@@ -392,6 +432,13 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         _eval(num_steps - 1)
     if checkpoint_dir and checkpoint_every and num_steps > start_step:
         _save(num_steps - 1)
+    if report is not None:
+        report.count("steps", max(num_steps - start_step, 0))
+        if history:
+            report.gauge("final_loss", history[-1][1])
+        if telemetry is not None:
+            report.attach_telemetry(telemetry)
+        report.write()
     return params, history
 
 

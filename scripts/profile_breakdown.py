@@ -14,6 +14,14 @@ Usage (real TPU):
     python scripts/profile_breakdown.py ref          # L8/H8, batch 32, seq 128
     python scripts/profile_breakdown.py gpt2-small --json out.json
 
+Offline mode (no chip, no profiler — any host):
+    python scripts/profile_breakdown.py --from-report /path/report.json
+
+reads a run-report manifest (``utils.telemetry.RunReport.write``, e.g.
+from ``fit(report_dir=...)`` or ``$BENCH_REPORT_PATH``) and prints its
+measured pipeline timeline + per-stage F/B/W/idle breakdown — the
+host-stamped complement to the XPlane parse (docs/observability.md).
+
 The reference's only instrumentation is ``time.time()`` around the timed
 loop (SURVEY.md §5); this is the TPU-native deep end of that row.
 """
@@ -195,13 +203,67 @@ def parse(log_dir: str, n_steps: int) -> dict:
     }
 
 
+def report_breakdown(manifest: dict) -> None:
+    """Print the telemetry section of a run-report manifest: phase/tick
+    timeline and the per-stage F/B/W/idle attribution. Pure host-side —
+    works on any machine with just the JSON in hand."""
+    meta = manifest.get("meta", {})
+    tel = manifest.get("telemetry")
+    if not tel:
+        raise SystemExit(
+            "report has no 'telemetry' section — the run was not "
+            "instrumented (pass a PipelineTelemetry into make_pipeline_step "
+            "/ fit and re-run; docs/observability.md)")
+    print(f"=== run report: {meta.get('name', '?')} "
+          f"(executor={tel.get('executor', '?')}, "
+          f"backend={meta.get('backend', '?')}) ===")
+    timeline = tel.get("timeline", [])
+    if timeline:
+        print(f"\n{'segment':12s} {'ticks':>12s} {'dur ms':>9s} "
+              f"{'ms/tick':>9s}")
+        for rec in timeline:
+            kind = rec.get("kind", "?")
+            label = (f"phase {rec['phase']}" if kind == "phase"
+                     else f"tick {rec.get('tick', '?')}" if kind == "tick"
+                     else kind)
+            t0, n = rec.get("start_tick", 0), max(rec.get("n_ticks", 1), 1)
+            dur = rec.get("duration_s") or 0.0
+            print(f"{label:12s} {f'{t0}..{t0 + n - 1}':>12s} "
+                  f"{dur * 1e3:9.3f} {dur / n * 1e3:9.3f}")
+    sb = tel.get("stage_breakdown")
+    if sb:
+        print(f"\ntotal {sb['total_s'] * 1e3:.3f} ms — split "
+              f"F {sb['f_frac']:.1%} / B {sb['b_frac']:.1%} / "
+              f"W {sb['w_frac']:.1%}; mean measured bubble "
+              f"{sb['bubble_measured_mean']:.1%}")
+        print(f"{'stage':>6s} {'F ms':>8s} {'B ms':>8s} {'W ms':>8s} "
+              f"{'idle ms':>8s} {'bubble':>7s}")
+        for row in sb["per_stage"]:
+            print(f"{row['device']:6d} {row['f_s'] * 1e3:8.3f} "
+                  f"{row['b_s'] * 1e3:8.3f} {row['w_s'] * 1e3:8.3f} "
+                  f"{row['idle_s'] * 1e3:8.3f} "
+                  f"{row['bubble_measured']:6.1%}")
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("config", choices=["ref", "gpt2-small", "gpt2-medium",
-                                       "llama-1b", "gpt2-small-8k"])
+    ap.add_argument("config", nargs="?",
+                    choices=["ref", "gpt2-small", "gpt2-medium",
+                             "llama-1b", "gpt2-small-8k"])
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--json", default=None, help="also write the result here")
+    ap.add_argument("--from-report", default=None, metavar="REPORT_JSON",
+                    help="offline mode: print the measured pipeline timeline "
+                         "and per-stage breakdown from a run-report manifest "
+                         "instead of capturing a trace")
     args = ap.parse_args()
+
+    if args.from_report:
+        with open(args.from_report) as f:
+            report_breakdown(json.load(f))
+        return
+    if args.config is None:
+        ap.error("config is required unless --from-report is given")
 
     step, params, tokens, targets, tokens_per_step = build_step(args.config)
     log_dir = tempfile.mkdtemp(prefix="profile_breakdown_")

@@ -14,6 +14,16 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 BUDGET="${1:-870}"
+# Telemetry liveness first (own small budget, not charged to the suite's):
+# one instrumented pipeline step must produce a validated run report —
+# the observability layer's equivalent of "does it import". The report
+# lands in /tmp/telemetry_smoke for CI artifact upload.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python scripts/telemetry_smoke.py /tmp/telemetry_smoke; then
+  echo "TELEMETRY_SMOKE=fail"
+  exit 1
+fi
+echo "TELEMETRY_SMOKE=ok"
 rm -f /tmp/_t1.log
 timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
