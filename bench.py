@@ -376,5 +376,85 @@ def run(num_iterations: int = 20) -> dict:
     return _result(headline, extra, n_pipe)
 
 
+def run_serve() -> dict:
+    """``--serve``: the continuous-vs-static serving comparison.
+
+    Replays one synthetic Poisson trace through the slot-level serving
+    executor (``serving/``) under both admission policies and prints the
+    comparison row. Same backend discipline as the training headline:
+    bounded retry then CPU fallback, never rc=1. Serving needs a
+    multi-device pipe mesh, so a single-device host re-creates the cpu
+    client with 8 simulated devices — the same proxy the test suite
+    uses — and the row is labelled a proxy."""
+    from distributed_training_with_pipeline_parallelism_tpu.serving.bench import (
+        run_serve_bench)
+    from distributed_training_with_pipeline_parallelism_tpu.utils.telemetry import (
+        RunReport, validate_report)
+    backend = _init_backend()
+    if len(jax.devices()) < 2:
+        # single chip (or cpu): switch to the simulated-cpu mesh. The
+        # host device count flag only takes effect if XLA_FLAGS carried
+        # it before the FIRST backend init — ``__main__`` sets it for
+        # ``--serve`` before any device query, so the fresh cpu client
+        # here comes up with 8 devices.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            from jax.extend import backend as _jex_backend
+            _jex_backend.clear_backends()
+        except Exception:  # pragma: no cover - version-dependent internals
+            pass
+        backend["backend"] = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    if backend["backend"] == "cpu":
+        backend["serve_proxy"] = (f"{n_dev} simulated cpu devices — "
+                                  "scheduling comparison only, NOT "
+                                  "accelerator numbers")
+    report = RunReport(name="serve_bench")
+    report.set_meta(n_devices=n_dev,
+                    **{k: backend[k] for k in
+                       ("backend", "backend_fallback", "backend_attempts",
+                        "backend_error", "serve_proxy") if k in backend})
+    row = run_serve_bench(report=report)
+    for k in ("continuous_tokens_per_sec", "static_tokens_per_sec",
+              "throughput_gain", "tick_gain", "ttft_p50_ticks",
+              "ttft_p99_ticks"):
+        if row.get(k) is not None:
+            report.gauge(f"serve_{k}", row[k])
+    manifest = report.manifest()
+    validate_report(manifest)
+    extra = {**row, **backend}
+    path = (os.environ.get("SERVE_REPORT_PATH")
+            or os.environ.get("BENCH_REPORT_PATH"))
+    if path:
+        with open(path, "w") as fh:
+            json.dump(manifest, fh, indent=2)
+            fh.write("\n")
+        extra["run_report_path"] = path
+    else:
+        extra["run_report"] = manifest
+    proxy = " (cpu proxy)" if "serve_proxy" in backend else ""
+    return {
+        "metric": (f"continuous-batching serving throughput vs static "
+                   f"fill-drain (Poisson trace, {row['n_requests']} "
+                   f"requests, load {row['load']}, {row['n_pipe']}-stage "
+                   f"ring, {row['n_slots']} slots{proxy})"),
+        "value": row["continuous_tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_static": row["throughput_gain"],
+        "extra": extra,
+    }
+
+
 if __name__ == "__main__":
-    print(json.dumps(run()))
+    if "--serve" in sys.argv:
+        # must land in XLA_FLAGS before the first backend init; it only
+        # affects the cpu client, so it is harmless when a TPU is present
+        if "xla_force_host_platform_device_count" not in os.environ.get(
+                "XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                " --xla_force_host_platform_device_count=8")
+        print(json.dumps(run_serve()))
+    else:
+        print(json.dumps(run()))

@@ -21,6 +21,7 @@ from .utils.config import (MeshConfig, ModelConfig, RunConfig, ScheduleConfig,
 #   dtpp.make_pipeline_forward(...)  pipelined batch inference logits
 #   dtpp.fsdp_shard_params(...)      pp x fsdp resting placement
 #   dtpp.fit(...)                    training loop (optax + orbax)
+#   dtpp.ServingEngine(...)          continuous-batching serving (docs/serving.md)
 _LAZY = {
     "make_mesh": ("parallel.mesh", "make_mesh"),
     "init_multihost": ("parallel.mesh", "init_multihost"),
@@ -40,6 +41,10 @@ _LAZY = {
     "run_all_experiments": ("utils.sweep", "run_all_experiments"),
     "run_one_experiment": ("utils.sweep", "run_one_experiment"),
     "MoEConfig": ("models.moe", "MoEConfig"),
+    "Request": ("serving", "Request"),
+    "ServingEngine": ("serving", "ServingEngine"),
+    "make_serving_step_fn": ("serving", "make_serving_step_fn"),
+    "run_serve_bench": ("serving.bench", "run_serve_bench"),
 }
 
 

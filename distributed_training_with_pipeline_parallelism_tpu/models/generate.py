@@ -229,15 +229,30 @@ def generate(cfg: ModelConfig, params: Pytree, prompt: jax.Array,
              max_new_tokens: int, *, key: Optional[jax.Array] = None,
              temperature: float = 0.0, top_k: Optional[int] = None,
              top_p: Optional[float] = None,
-             max_len: Optional[int] = None) -> jax.Array:
+             max_len: Optional[int] = None,
+             eos_id: Optional[int] = None,
+             return_lengths: bool = False) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` [B, P].
 
     Returns [B, P + max_new_tokens]. Pure and jittable (see
     :func:`make_generate_fn` for the pre-jitted closure); the decode loop is a
     single ``lax.scan``.
+
+    With ``eos_id`` decoding is EOS-aware while keeping every shape
+    static: once a row emits ``eos_id`` it is *frozen* — its KV-cache
+    writes are masked (``jnp.where`` keeps the old cache bit-for-bit)
+    and every subsequent emitted token is forced to ``eos_id``. With
+    ``return_lengths=True`` (requires ``eos_id``) returns
+    ``(tokens [B, P+N], lengths [B])`` where ``lengths`` counts emitted
+    tokens per row including the EOS itself (N when no EOS appeared).
+    These are exactly the freeze semantics of the pipelined decoder and
+    the serving executor, so all three stay token-for-token comparable.
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if return_lengths and eos_id is None:
+        raise ValueError("return_lengths=True requires an eos_id (without "
+                         "one every row emits exactly max_new_tokens)")
     b, p = prompt.shape
     total = p + max_new_tokens
     max_len = max_len or total
@@ -259,25 +274,54 @@ def generate(cfg: ModelConfig, params: Pytree, prompt: jax.Array,
                             max_new_tokens)
     first = sample_logits(keys[0], logits, temperature, top_k, top_p)
 
-    def step(carry, step_key):
-        cache, tok, pos = carry
-        logits, cache = _forward_with_cache(cfg, params, cache, tok[:, None],
-                                            pos)
-        nxt = sample_logits(step_key, logits, temperature, top_k, top_p)
-        return (cache, nxt, pos + 1), tok
+    if eos_id is None:
+        def step(carry, step_key):
+            cache, tok, pos = carry
+            logits, cache = _forward_with_cache(cfg, params, cache,
+                                                tok[:, None], pos)
+            nxt = sample_logits(step_key, logits, temperature, top_k, top_p)
+            return (cache, nxt, pos + 1), tok
 
-    (_, last, _), toks = jax.lax.scan(step, (cache, first, jnp.int32(p)),
-                                      keys[1:])
+        (_, last, _), toks = jax.lax.scan(step, (cache, first, jnp.int32(p)),
+                                          keys[1:])
+    else:
+        # a row is done once the token it is ABOUT to consume is EOS —
+        # that token's KV never enters the cache and all later emissions
+        # are forced to eos_id (same freeze rule as pipelined_decode)
+        def step(carry, step_key):
+            cache, tok, pos, done = carry
+            logits, cache2 = _forward_with_cache(cfg, params, cache,
+                                                 tok[:, None], pos)
+            m = done[None, :, None, None, None]
+            cache = jax.tree.map(lambda old, new: jnp.where(m, old, new),
+                                 cache, cache2)
+            nxt = sample_logits(step_key, logits, temperature, top_k, top_p)
+            nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+            return (cache, nxt, pos + 1, done | (nxt == eos_id)), tok
+
+        done0 = first == eos_id
+        (_, last, _, _), toks = jax.lax.scan(
+            step, (cache, first, jnp.int32(p), done0), keys[1:])
+
     new = jnp.concatenate([jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
-    return jnp.concatenate([prompt, new.astype(prompt.dtype)], axis=1)
+    out = jnp.concatenate([prompt, new.astype(prompt.dtype)], axis=1)
+    if not return_lengths:
+        return out
+    hit = new == eos_id
+    lengths = jnp.where(hit.any(axis=1), jnp.argmax(hit, axis=1) + 1,
+                        max_new_tokens).astype(jnp.int32)
+    return out, lengths
 
 
 def make_generate_fn(cfg: ModelConfig, max_new_tokens: int, *,
                      temperature: float = 0.0, top_k: Optional[int] = None,
                      top_p: Optional[float] = None,
-                     max_len: Optional[int] = None):
+                     max_len: Optional[int] = None,
+                     eos_id: Optional[int] = None,
+                     return_lengths: bool = False):
     """Jitted (params, prompt, key) -> tokens closure over the static knobs."""
     fn = functools.partial(generate, cfg, max_new_tokens=max_new_tokens,
                            temperature=temperature, top_k=top_k, top_p=top_p,
-                           max_len=max_len)
+                           max_len=max_len, eos_id=eos_id,
+                           return_lengths=return_lengths)
     return jax.jit(lambda params, prompt, key=None: fn(params, prompt, key=key))

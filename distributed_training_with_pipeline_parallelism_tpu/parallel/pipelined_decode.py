@@ -56,15 +56,96 @@ from .pipeline import (_check_tp_divisibility, _dense_layer_specs,
                        _shard_map, stack_stage_layers)
 
 
+def _slot_cache_apply(cfg: ModelConfig, layers_d, h, kc, vc, g, n_rows: int,
+                      offset, s: int, *, tp_axis: Optional[str] = None,
+                      tp_size: int = 1, live_rows=None):
+    """One stage's layer slice on ``h`` [n_rows, s, dim] for slot/stream
+    ``g``: slice that slot's cache rows (``g*n_rows .. (g+1)*n_rows``),
+    run the blocks, write the new k/v back.
+
+    ``live_rows`` (optional [n_rows] bool) masks the cache write-back per
+    batch row — frozen rows (EOS-finished streams, retired serving slots)
+    keep their previous k/v bit-for-bit, so completed requests stop
+    mutating state without changing any shape. Shared by the static
+    round-robin decoder below and the continuous-batching serving
+    executor (:mod:`..serving.engine`)."""
+    kg = jax.lax.dynamic_slice_in_dim(kc, g * n_rows, n_rows, axis=1)
+    vg = jax.lax.dynamic_slice_in_dim(vc, g * n_rows, n_rows, axis=1)
+    rope = rope_slice_at(cfg, kc.shape[2], offset, s)
+    h, (kg2, vg2) = layers_with_cache(cfg, layers_d, h, kg, vg, offset, rope,
+                                      tp_axis=tp_axis, tp_size=tp_size)
+    if live_rows is not None:
+        m = live_rows[None, :, None, None, None]
+        kg2 = jnp.where(m, kg2, kg)
+        vg2 = jnp.where(m, vg2, vg)
+    kc = jax.lax.dynamic_update_slice_in_dim(kc, kg2, g * n_rows, axis=1)
+    vc = jax.lax.dynamic_update_slice_in_dim(vc, vg2, g * n_rows, axis=1)
+    return h, kc, vc
+
+
+def _head_token(cfg: ModelConfig, head_c, embed_c, y_last, key, *,
+                temperature: float = 0.0, top_k: Optional[int] = None,
+                top_p: Optional[float] = None, tp_axis: Optional[str] = None,
+                tp_size: int = 1, vocab_parallel: bool = False):
+    """Next-token ids [B] from the last-position hidden ``y_last``
+    [B, 1, dim] — the last-stage head of both decode executors (the
+    caller conds on its stage index so other stages skip the vocab
+    matmul entirely).
+
+    Greedy under TP goes vocab-parallel when ``vocab_parallel``: each
+    model rank reads only its V/T column slice of the head weight (the
+    O(dim*V) head read is often the largest weight in a decode tick —
+    replicating it would cap the TP speedup well below T) and the argmax
+    merges via a [T, B] all_gather of per-shard (max, argmax) pairs.
+    First-max-wins on both levels reproduces the global argmax tie-break
+    (lowest index) exactly. Sampling keeps the replicated head: top-k /
+    top-p need globally truncated logits."""
+    if not vocab_parallel:
+        logits = head_apply(cfg, head_c, y_last, embed=embed_c)[:, 0]
+        return sample_logits(key, logits, temperature, top_k,
+                             top_p).astype(jnp.int32)
+    from ..models.transformer import head_norm_apply
+    t = jax.lax.axis_index(tp_axis)
+    Vl = cfg.vocab_size // tp_size
+    hn = head_norm_apply(cfg, head_c, y_last)[:, 0]  # [B, dim]
+    if cfg.tie_embeddings:
+        wsl = jax.lax.dynamic_slice_in_dim(
+            embed_c["tok"], t * Vl, Vl, axis=0)  # [Vl, dim]
+        logits_l = hn @ wsl.T
+    else:
+        wsl = jax.lax.dynamic_slice_in_dim(
+            head_c["out"]["w"], t * Vl, Vl, axis=1)
+        logits_l = hn @ wsl  # gpt2/llama heads carry no bias
+    val = jnp.max(logits_l, axis=-1)
+    idx = jnp.argmax(logits_l, axis=-1) + t * Vl
+    vals = jax.lax.all_gather(val, tp_axis)  # [T, B]
+    idxs = jax.lax.all_gather(idx, tp_axis)
+    win = jnp.argmax(vals, axis=0)
+    return jnp.take_along_axis(idxs, win[None], axis=0)[0].astype(jnp.int32)
+
+
 def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
                               max_new_tokens: int, *,
                               n_streams: Optional[int] = None,
                               temperature: float = 0.0,
                               top_k: Optional[int] = None,
                               top_p: Optional[float] = None,
-                              max_len: Optional[int] = None):
+                              max_len: Optional[int] = None,
+                              eos_id: Optional[int] = None,
+                              return_lengths: bool = False):
     """Build a jitted ``(params, prompt[, key]) -> tokens [B, P+N]``
     decoder over ``mesh``'s 'pipe' axis.
+
+    ``eos_id`` makes decoding EOS-aware: once a request emits ``eos_id``
+    its stream freezes — subsequent banked tokens are forced to
+    ``eos_id`` and every stage masks that request's KV-cache writes (a
+    live-row mask rides the same ring hop as the data, so jit shapes
+    never change), and a stream whose requests have ALL finished skips
+    its stage compute entirely instead of burning ticks to
+    ``max_new_tokens``. With ``return_lengths=True`` (requires
+    ``eos_id``) the decoder returns ``(tokens [B, P+N], lengths [B])``
+    where ``lengths`` counts emitted tokens per request including the
+    EOS itself.
 
     ``params`` is the full-model pytree (stage slicing happens inside,
     via the training executor's ``stack_stage_layers``); ``prompt`` is
@@ -104,6 +185,9 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
     N = max_new_tokens
     if N < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {N}")
+    if return_lengths and eos_id is None:
+        raise ValueError("return_lengths=True requires an eos_id (without "
+                         "one every stream emits exactly max_new_tokens)")
     if temperature != 0.0:
         need_key = True
     else:
@@ -116,17 +200,9 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
         embed_c = compute_cast(cfg, embed)
         head_c = compute_cast(cfg, head)
         B, Pp = prompt.shape
-        assert B % M == 0, f"batch {B} not divisible by n_streams={M}"
         Bg = B // M
         total = Pp + N
         mlen = max_len or total
-        if total > mlen:
-            raise ValueError(f"prompt ({Pp}) + max_new_tokens ({N}) "
-                             f"exceeds max_len ({mlen})")
-        if cfg.arch == "gpt2" and total > cfg.max_seq_len:
-            raise ValueError(f"prompt ({Pp}) + max_new_tokens ({N}) "
-                             f"exceeds the gpt2 position table "
-                             f"(max_seq_len={cfg.max_seq_len})")
         lps = cfg.n_layers // D
         # under TP each model rank caches only ITS kv-head shard
         n_kv = (cfg.n_kv_heads or cfg.n_heads) // T
@@ -142,24 +218,13 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
             return jax.tree.map(
                 lambda x: jax.lax.ppermute(x, PIPE_AXIS, perm), tree)
 
-        def stage_apply(h, kc, vc, g, offset, s):
-            """This device's layer slice on [Bg, s, dim] for stream g:
-            slice the stream's cache rows, run, write back."""
-            kg = jax.lax.dynamic_slice_in_dim(kc, g * Bg, Bg, axis=1)
-            vg = jax.lax.dynamic_slice_in_dim(vc, g * Bg, Bg, axis=1)
-            rope = rope_slice_at(cfg, kc.shape[2], offset, s)
-            h, (kg, vg) = layers_with_cache(cfg, layers_d, h, kg, vg,
-                                            offset, rope, tp_axis=tp_axis,
-                                            tp_size=T)
-            kc = jax.lax.dynamic_update_slice_in_dim(kc, kg, g * Bg, axis=1)
-            vc = jax.lax.dynamic_update_slice_in_dim(vc, vg, g * Bg, axis=1)
-            return h, kc, vc
-
-        def sample(g, e, logits):
-            if not need_key:
-                return sample_logits(None, logits, 0.0, top_k, top_p)
-            k = jax.random.fold_in(jax.random.fold_in(base_key, e), g)
-            return sample_logits(k, logits, temperature, top_k, top_p)
+        def stage_apply(h, kc, vc, g, offset, s, live_rows=None):
+            """This device's layer slice on [Bg, s, dim] for stream g
+            (shared :func:`_slot_cache_apply`; ``live_rows`` masks cache
+            writes of EOS-frozen requests)."""
+            return _slot_cache_apply(cfg, layers_d, h, kc, vc, g, Bg,
+                                     offset, s, tp_axis=tp_axis, tp_size=T,
+                                     live_rows=live_rows)
 
         # ------------------------------------------------------------------
         # prefill: fill-drain over whole prompts, M + D ticks (the +1 tick
@@ -169,47 +234,27 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
         tok_chan = jnp.zeros((Bg,), jnp.int32)
         token_buf = jnp.zeros((M, Bg), jnp.int32)
         out_buf = jnp.zeros((N, M, Bg), jnp.int32)
+        # EOS bookkeeping lives on stage 0 only (it banks every token);
+        # stages d > 0 learn liveness from the mask riding the ring. All
+        # of it is gated at Python level so the eos_id=None jaxpr is
+        # unchanged.
+        use_eos = eos_id is not None
+        done = jnp.zeros((M, Bg), bool) if use_eos else None
 
         vocab_parallel_head = (tp_axis is not None and not need_key
                                and cfg.vocab_size % T == 0)
 
         def head_sample(y_last, g, e):
-            """Last stage only: logits + sample; other stages skip the
-            vocab matmul entirely.
-
-            Greedy under TP goes vocab-parallel: each model rank reads
-            only its V/T column slice of the head weight (the O(dim*V)
-            head read is often the largest weight in a decode tick —
-            replicating it would cap the TP speedup well below T) and
-            the argmax merges via a [T, Bg] all_gather of per-shard
-            (max, argmax) pairs. First-max-wins on both levels
-            reproduces the global argmax tie-break (lowest index)
-            exactly. Sampling keeps the replicated head: top-k/top-p
-            need globally truncated logits."""
+            """Last stage only: logits + sample via the shared
+            :func:`_head_token` (vocab-parallel greedy under TP); other
+            stages skip the vocab matmul entirely."""
             def live():
-                if not vocab_parallel_head:
-                    logits = head_apply(cfg, head_c, y_last,
-                                        embed=embed_c)[:, 0]
-                    return sample(g, e, logits).astype(jnp.int32)
-                from ..models.transformer import head_norm_apply
-                t = jax.lax.axis_index(tp_axis)
-                Vl = cfg.vocab_size // T
-                hn = head_norm_apply(cfg, head_c, y_last)[:, 0]  # [Bg, dim]
-                if cfg.tie_embeddings:
-                    wsl = jax.lax.dynamic_slice_in_dim(
-                        embed_c["tok"], t * Vl, Vl, axis=0)  # [Vl, dim]
-                    logits_l = hn @ wsl.T
-                else:
-                    wsl = jax.lax.dynamic_slice_in_dim(
-                        head_c["out"]["w"], t * Vl, Vl, axis=1)
-                    logits_l = hn @ wsl  # gpt2/llama heads carry no bias
-                val = jnp.max(logits_l, axis=-1)
-                idx = jnp.argmax(logits_l, axis=-1) + t * Vl
-                vals = jax.lax.all_gather(val, tp_axis)  # [T, Bg]
-                idxs = jax.lax.all_gather(idx, tp_axis)
-                win = jnp.argmax(vals, axis=0)
-                return jnp.take_along_axis(idxs, win[None], axis=0)[0] \
-                    .astype(jnp.int32)
+                key = (jax.random.fold_in(jax.random.fold_in(base_key, e), g)
+                       if need_key else None)
+                return _head_token(cfg, head_c, embed_c, y_last, key,
+                                   temperature=temperature, top_k=top_k,
+                                   top_p=top_p, tp_axis=tp_axis, tp_size=T,
+                                   vocab_parallel=vocab_parallel_head)
 
             return jax.lax.cond(d == D - 1, live,
                                 lambda: jnp.zeros((Bg,), jnp.int32))
@@ -224,6 +269,10 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
                                       token_buf)
                 out_buf = jnp.where(is_d0, out_buf.at[0, wp].set(tok_chan),
                                     out_buf)
+                if use_eos:  # a prompt may yield EOS as its FIRST token
+                    done = jnp.where(is_d0,
+                                     done.at[wp].set(tok_chan == eos_id),
+                                     done)
             w = t - d  # this device's active stream this tick
             active = (w >= 0) & (w < M)
             g = jnp.clip(w, 0, M - 1)
@@ -253,17 +302,30 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
         h1 = jnp.zeros((Bg, 1, cfg.dim), jnp.dtype(cfg.dtype))
 
         def tick(carry, u):
-            h_chan, tok_chan, kc, vc, token_buf, out_buf = carry
+            if use_eos:
+                (h_chan, tok_chan, kc, vc, token_buf, out_buf, done,
+                 lives_chan) = carry
+            else:
+                h_chan, tok_chan, kc, vc, token_buf, out_buf = carry
+                done = lives_chan = None
             # bank the arrival from tick u-1 (which left the last stage at
             # entry index (u - D) // M, producing output token index +1)
             wa = u - D
             ga = jnp.clip(wa % M, 0, M - 1)
             ia = jnp.clip(wa // M + 1, 0, N - 1)
             bank = (wa >= 0) & (d == 0)
-            token_buf = jnp.where(bank, token_buf.at[ga].set(tok_chan),
+            # finished rows emit forced EOS from then on; the garbage the
+            # skipped/frozen compute produced never reaches the output
+            tok_eff = (jnp.where(done[ga], jnp.int32(eos_id), tok_chan)
+                       if use_eos else tok_chan)
+            token_buf = jnp.where(bank, token_buf.at[ga].set(tok_eff),
                                   token_buf)
-            out_buf = jnp.where(bank, out_buf.at[ia, ga].set(tok_chan),
+            out_buf = jnp.where(bank, out_buf.at[ia, ga].set(tok_eff),
                                 out_buf)
+            if use_eos:
+                done = jnp.where(
+                    bank, done.at[ga].set(done[ga] | (tok_eff == eos_id)),
+                    done)
 
             w = u - d
             active = (w >= 0) & (w < M * (N - 1))
@@ -271,13 +333,25 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
             e = jnp.clip(w // M, 0, max(N - 2, 0))  # entry index
             pos = Pp + e  # the consumed token's global position
 
+            if use_eos:
+                # banking above ran first, so in the M == D case where a
+                # stream's token arrives and is consumed in the same tick,
+                # `done` already reflects it. Stage 0 reads its own table;
+                # later stages reuse the mask that rode in with the data.
+                lives = jnp.where(d == 0, ~done[g], lives_chan)
+                # a stream whose rows ALL hit EOS skips its stage compute
+                # entirely — that's the satellite's "stop burning ticks"
+                active = active & jnp.any(lives)
+            else:
+                lives = None
+
             def unit(op):
                 kc, vc = op
                 x = jnp.where(d == 0,
                               _embed_at(cfg, embed_c, token_buf[g][:, None],
                                         pos).astype(h1.dtype),
                               h_chan)
-                y, kc, vc = stage_apply(x, kc, vc, g, pos, 1)
+                y, kc, vc = stage_apply(x, kc, vc, g, pos, 1, live_rows=lives)
                 tok = head_sample(y, g, e + 1)
                 return (kc, vc), y, tok
 
@@ -285,19 +359,32 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
                 return op, jnp.zeros_like(h1), jnp.zeros((Bg,), jnp.int32)
 
             (kc, vc), y, tok = jax.lax.cond(active, unit, noop, (kc, vc))
+            if use_eos:
+                lives_out = lives & active
+                h_chan, tok_chan, lives_chan = ring((y, tok, lives_out))
+                return (h_chan, tok_chan, kc, vc, token_buf, out_buf, done,
+                        lives_chan), None
             h_chan, tok_chan = ring((y, tok))
             return (h_chan, tok_chan, kc, vc, token_buf, out_buf), None
 
         T_dec = M * (N - 1) + D
         if T_dec > 0 and N > 1:
-            (h1c, tok_chan, kc, vc, token_buf, out_buf), _ = jax.lax.scan(
-                tick, (h1, tok_chan, kc, vc, token_buf, out_buf),
-                jnp.arange(T_dec))
+            carry0 = (h1, tok_chan, kc, vc, token_buf, out_buf)
+            if use_eos:
+                carry0 = carry0 + (done, jnp.zeros((Bg,), bool))
+            carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T_dec))
+            token_buf, out_buf = carry[4], carry[5]
 
         # outputs live on device 0; psum replicates across the pipe ring
         out = jax.lax.psum(jnp.where(d == 0, out_buf, 0), PIPE_AXIS)
         # [N, M, Bg] -> [B, N]
-        return jnp.moveaxis(out, 0, -1).reshape(B, N)
+        toks = jnp.moveaxis(out, 0, -1).reshape(B, N)
+        if not use_eos:
+            return toks
+        hit = toks == eos_id
+        lengths = jnp.where(hit.any(axis=1), jnp.argmax(hit, axis=1) + 1,
+                            N).astype(jnp.int32)
+        return toks, lengths
 
     # layers: 'pipe' on the stage dim, plus Megatron 'model' dims when a
     # model axis is present (same stacked-layout specs as the training
@@ -311,14 +398,38 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
     )
 
     @jax.jit
+    def _gen(params, prompt, key_data):
+        stacked = stack_stage_layers(params["layers"], D, 1)
+        res = sharded(stacked, params["embed"], params["head"], prompt,
+                      key_data)
+        new = res[0] if eos_id is not None else res
+        toks = jnp.concatenate([prompt, new.astype(prompt.dtype)], axis=1)
+        if return_lengths:
+            return toks, res[1]
+        return toks
+
     def gen(params, prompt, key=None):
+        # precondition checks run OUTSIDE jit so violations surface as
+        # plain ValueErrors at the call site, not mid-trace
+        B, Pp = prompt.shape
+        if B % M:
+            raise ValueError(
+                f"batch {B} is not divisible by n_streams={M}; each "
+                "round-robin stream carries B/M requests, so pad the batch "
+                "or pick n_streams dividing it")
+        total = Pp + N
+        mlen = max_len or total
+        if total > mlen:
+            raise ValueError(f"prompt ({Pp}) + max_new_tokens ({N}) "
+                             f"exceeds max_len ({mlen})")
+        if cfg.arch == "gpt2" and total > cfg.max_seq_len:
+            raise ValueError(f"prompt ({Pp}) + max_new_tokens ({N}) "
+                             f"exceeds the gpt2 position table "
+                             f"(max_seq_len={cfg.max_seq_len})")
         if need_key and key is None:
             raise ValueError("sampling (temperature != 0) requires a PRNG "
                              "key")
-        stacked = stack_stage_layers(params["layers"], D, 1)
         key = key if key is not None else jax.random.key(0)
-        new = sharded(stacked, params["embed"], params["head"], prompt,
-                      jax.random.key_data(key))
-        return jnp.concatenate([prompt, new.astype(prompt.dtype)], axis=1)
+        return _gen(params, prompt, jax.random.key_data(key))
 
     return gen

@@ -1,0 +1,14 @@
+"""Continuous-batching serving over the pipelined round-robin decoder.
+
+:mod:`.engine` — the slot-level executor: a jitted fixed-shape tick
+block over the pipe mesh plus a host-side scheduler that admits, retires
+and refills per-slot requests between blocks (ISSUE 7 tentpole).
+:mod:`.bench` — the synthetic Poisson-trace benchmark comparing
+continuous vs static batching.
+"""
+
+from .engine import (Completion, Request, ServeResult, ServingEngine,
+                     make_serving_step_fn)
+
+__all__ = ["Completion", "Request", "ServeResult", "ServingEngine",
+           "make_serving_step_fn"]

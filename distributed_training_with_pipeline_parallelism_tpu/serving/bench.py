@@ -1,0 +1,140 @@
+"""Serving benchmark: continuous vs static batching on a Poisson trace.
+
+Replays one synthetic arrival trace (Poisson gaps, mixed prompt/output
+lengths) through the SAME compiled :class:`.engine.ServingProgram` under
+both admission policies and reports the comparison as a single JSON row:
+``continuous`` refills a slot the moment its request retires;
+``static`` admits a fresh batch only after every slot has drained (the
+fill-drain baseline the static decoder implements). Because the tick
+program, weights and trace are identical, every difference in
+tokens/sec, ticks and TTFT is scheduling, not compute.
+
+Latency percentiles come from :func:`...utils.telemetry.serving_summary`
+(tick-exact on-device stamps); both summaries land in the RunReport's
+``serving`` section when a report is passed. The trace's offered load
+defaults to 1.5x the ring's service capacity — oversaturated, so a
+queue is always waiting (TTFT includes queue wait) and the scheduler,
+not arrival gaps, decides slot occupancy; the finite trace still
+drains.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.config import ModelConfig
+from ..utils.telemetry import serving_summary
+from .engine import Request, ServingEngine, make_serving_step_fn
+
+
+def synth_trace(n_requests: int, *, prompt_lens=(2, 12), out_lens=(2, 16),
+                prefill_chunk: int = 1, load: float = 0.8,
+                vocab_size: int = 64, seed: int = 0) -> List[Request]:
+    """A Poisson arrival trace with mixed prompt/output lengths.
+
+    Each slot visit is M ticks apart, and a request occupies its slot
+    for ``ceil(plen/C) + budget`` visits, so the ring's service capacity
+    is ``1 / mean_visits`` requests per tick regardless of M. Arrival
+    gaps are exponential with rate ``load`` x capacity — ``load < 1``
+    drains, ``load > 1`` builds an unbounded queue.
+    """
+    if not 0 < load:
+        raise ValueError(f"load must be > 0, got {load}")
+    rng = np.random.RandomState(seed)
+    plens = rng.randint(prompt_lens[0], prompt_lens[1] + 1, size=n_requests)
+    budgets = rng.randint(out_lens[0], out_lens[1] + 1, size=n_requests)
+    mean_visits = float(np.mean(np.ceil(plens / prefill_chunk) + budgets))
+    rate = load / mean_visits  # requests per tick
+    gaps = rng.exponential(1.0 / rate, size=n_requests)
+    gaps[0] = 0.0  # first request is waiting when the ring starts
+    arrivals = np.cumsum(gaps)
+    return [Request(rid=i,
+                    prompt=rng.randint(0, vocab_size, size=int(plens[i]))
+                    .tolist(),
+                    max_new_tokens=int(budgets[i]),
+                    arrival=float(arrivals[i]))
+            for i in range(n_requests)]
+
+
+def run_serve_bench(*, cfg: Optional[ModelConfig] = None, params=None,
+                    mesh=None, n_pipe: int = 2, n_slots: int = 4,
+                    prefill_chunk: int = 2, max_len: int = 48,
+                    prompt_max: int = 12, out_max: int = 16,
+                    n_requests: int = 24, load: float = 1.5,
+                    eos_id: Optional[int] = 1, seed: int = 0,
+                    reps: int = 3, report=None) -> Dict[str, Any]:
+    """Run the continuous-vs-static comparison; returns the JSON row.
+
+    With no ``cfg``/``params``/``mesh`` given, builds a small gpt2-family
+    model over an ``n_pipe``-stage pipe mesh — the CPU-proxy shape the
+    smoke/CI legs use. Pass real ones to measure real serving.
+    """
+    import jax
+
+    from ..models import transformer as tfm
+    from ..parallel.mesh import make_mesh
+
+    if cfg is None:
+        cfg = ModelConfig(arch="gpt2", dim=64, n_layers=4, n_heads=4,
+                          vocab_size=128, ffn_dim=128,
+                          max_seq_len=max_len + prefill_chunk - 1)
+    if mesh is None:
+        mesh = make_mesh(n_pipe=n_pipe)
+    if params is None:
+        params = tfm.transformer_init(jax.random.key(0), cfg)
+
+    trace = synth_trace(n_requests, prompt_lens=(2, prompt_max),
+                        out_lens=(2, out_max), prefill_chunk=prefill_chunk,
+                        load=load, vocab_size=cfg.vocab_size, seed=seed)
+    program = make_serving_step_fn(cfg, mesh, n_slots=n_slots,
+                                   max_len=max_len, prompt_max=prompt_max,
+                                   out_max=out_max,
+                                   prefill_chunk=prefill_chunk,
+                                   eos_id=eos_id)
+    engine = ServingEngine(program, params, report=report)
+
+    # compile outside the timed runs: one block on a throwaway state, so
+    # the first policy's wall-clock is serving, not XLA
+    warm = program.step(*engine.weights, program.init_state())
+    jax.block_until_ready(warm["u"])
+
+    results = {}
+    for policy in ("continuous", "static"):
+        # median-of-reps wall clock, same discipline as the training
+        # headline (the replay is deterministic, so any rep's tokens do)
+        runs = [engine.run(trace, policy=policy) for _ in range(max(1, reps))]
+        res = sorted(runs, key=lambda r: r.wall_s)[len(runs) // 2]
+        results[policy] = res
+        if report is not None:
+            report.attach_serving(serving_summary(res))
+
+    cont, stat = results["continuous"], results["static"]
+    # same program + greedy: both policies must emit identical tokens per
+    # request — anything else is a scheduler bug, not a perf difference
+    by_rid = {c.rid: c.tokens for c in stat.completions}
+    outputs_match = all(by_rid.get(c.rid) == c.tokens
+                        for c in cont.completions)
+    sc, ss = serving_summary(cont), serving_summary(stat)
+    for s in (sc, ss):
+        s.pop("occupancy", None)  # keep the JSON row compact
+    row = {
+        "bench": "serve",
+        "n_slots": n_slots, "n_pipe": mesh.shape["pipe"],
+        "prefill_chunk": prefill_chunk, "n_requests": n_requests,
+        "load": load, "eos_id": eos_id, "seed": seed,
+        "outputs_match": bool(outputs_match),
+        "continuous_tokens_per_sec": sc["tokens_per_sec"],
+        "static_tokens_per_sec": ss["tokens_per_sec"],
+        "throughput_gain": (sc["tokens_per_sec"] / ss["tokens_per_sec"]
+                            if ss["tokens_per_sec"] else None),
+        "ticks_continuous": sc["ticks"], "ticks_static": ss["ticks"],
+        "tick_gain": (ss["ticks"] / sc["ticks"] if sc["ticks"] else None),
+        "ttft_p50_ticks": sc["ttft_ticks"]["p50"],
+        "ttft_p99_ticks": sc["ttft_ticks"]["p99"],
+        "ttft_p50_ticks_static": ss["ttft_ticks"]["p50"],
+        "ttft_p99_ticks_static": ss["ttft_ticks"]["p99"],
+        "continuous": sc, "static": ss,
+    }
+    return row

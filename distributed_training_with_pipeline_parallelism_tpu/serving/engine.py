@@ -1,0 +1,591 @@
+"""Slot-level continuous batching over the pipelined round-robin decoder.
+
+The static decoder (:mod:`..parallel.pipelined_decode`) keeps every pipe
+stage busy by round-robining ``M >= D`` independent streams, but all M
+streams start together and drain together — mixed-length requests waste
+slots exactly the way a fill-drain schedule wastes bubbles. This module
+makes each stream a *slot* an open request queue feeds:
+
+- ``make_serving_step_fn`` builds ONE jitted SPMD program that advances
+  the ring by a fixed ``block_ticks`` ticks. Every shape in it is
+  static: per-slot caches ``[lps, M, max_len + C - 1, Hkv, hd]``, a
+  ``[1, C, dim]`` ring channel (C = prefill chunk), int32 slot-state
+  vectors. A slot's whole lifecycle — chunked prefill, decode, EOS /
+  budget retirement, sitting idle — is data, not shape, so the program
+  compiles once and serves forever.
+- tick ``u``, device ``d`` serves slot ``(u - d) mod M``, exactly the
+  decoder's schedule. Stage 0 owns the authoritative slot state; a small
+  int32 metadata vector ``(offset, s_valid, sample?, live?)`` rides the
+  same ``ppermute`` as the activations, so stages ``d > 0`` need no slot
+  knowledge at all — they apply their layer slice at the offset the
+  metadata names, and the last stage samples only when the metadata says
+  this chunk ends in a sampling position.
+- *chunked prefill*: a newly admitted request's prompt enters C tokens
+  per visit while every other slot keeps decoding — admission never
+  stalls the ring. Rows past ``s_valid`` in a chunk are garbage but
+  provably invisible: the band mask hides cache keys beyond the query's
+  position, and the next chunk's write covers the garbage rows before
+  the valid frontier reaches them (same argument for the C-1 junk rows a
+  decode step writes).
+- :class:`ServingEngine` drives the program from the host *between*
+  blocks: retire slots whose ``finished`` flag is set (EOS or per-request
+  budget — by then nothing of that slot is in flight, because a slot's
+  next visit comes ``M >= D`` ticks after its token lands), refill them
+  from the pending queue, fast-forward ``u`` across fully-idle gaps.
+  ``policy="continuous"`` refills per slot; ``policy="static"`` admits
+  only when ALL slots have drained — the fill-drain baseline the
+  benchmark compares against, on the *same compiled program*.
+
+Per-request latency stamps (``t_first``/``t_finish``, in ticks) are
+written on-device at banking time, so TTFT and per-output-token time are
+exact even though the host only observes block boundaries. Sampling is
+greedy (temperature 0): continuous batching interleaves requests into
+one sequential token stream, and greedy is what the oracle-parity tests
+pin against single-device :func:`...models.generate.generate`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.generate import _embed_at
+from ..models.transformer import compute_cast
+from ..parallel.mesh import MODEL_AXIS, PIPE_AXIS
+from ..parallel.pipeline import (_check_tp_divisibility, _dense_layer_specs,
+                                 _shard_map, stack_stage_layers)
+from ..parallel.pipelined_decode import _head_token, _slot_cache_apply
+from ..utils.config import ModelConfig
+
+# state leaves the host scheduler reads back after every block (small:
+# O(M) ints plus the [M, out_max] output buffer — never the caches)
+_HOST_KEYS = ("u", "finished", "emitted", "pos", "prefill_left",
+              "t_first", "t_finish", "out_buf", "tok")
+# leaves the host may write between blocks (numpy mirrors re-uploaded with
+# their pinned sharding only when dirty, so admission costs one transfer,
+# not a cascade of per-slot jitted updates)
+_SCHED_KEYS = _HOST_KEYS + ("budget", "plen", "live", "prompt_buf")
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request: ``prompt`` token ids, a per-request output
+    budget, and an arrival time in *ticks* (0 = available immediately)."""
+    rid: int
+    prompt: Sequence[int]
+    max_new_tokens: int
+    arrival: float = 0.0
+
+
+@dataclasses.dataclass
+class Completion:
+    """A finished request with its emitted tokens and tick-exact stamps.
+
+    ``tokens`` includes the EOS token when the request ended on one.
+    ``ttft_ticks`` counts from *arrival* (queue wait included);
+    ``tpot_ticks`` is the mean tick gap between consecutive output
+    tokens (None for single-token outputs)."""
+    rid: int
+    prompt: List[int]
+    tokens: List[int]
+    slot: int
+    admit_tick: int
+    first_token_tick: int
+    finish_tick: int
+    arrival: float
+
+    @property
+    def ttft_ticks(self) -> float:
+        return self.first_token_tick - self.arrival
+
+    @property
+    def tpot_ticks(self) -> Optional[float]:
+        n = len(self.tokens)
+        if n < 2:
+            return None
+        return (self.finish_tick - self.first_token_tick) / (n - 1)
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """What :meth:`ServingEngine.run` returns: completions in finish
+    order, the slot-occupancy timeline sampled at every block boundary
+    (``(tick, n_active_slots)``), total ticks the ring advanced, and the
+    host wall-clock the run took."""
+    completions: List[Completion]
+    occupancy: List[Any]
+    ticks: int
+    wall_s: float
+    n_slots: int
+    policy: str
+
+    @property
+    def tokens_out(self) -> int:
+        return sum(len(c.tokens) for c in self.completions)
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.tokens_out / self.wall_s if self.wall_s > 0 else 0.0
+
+    @property
+    def goodput(self) -> float:
+        """Emitted tokens per slot-visit — the schedule-quality number
+        (1.0 would mean every slot emitted a token on every ring round),
+        independent of host/hardware speed. Each slot gets ticks/M
+        visits, so this is tokens_out / ticks."""
+        return self.tokens_out / self.ticks if self.ticks else 0.0
+
+
+class ServingProgram:
+    """The compiled tick-block step + its static configuration.
+
+    Built by :func:`make_serving_step_fn`; drive it through
+    :class:`ServingEngine` (or call ``prepare(params)`` +
+    ``step(stacked, embed, head, state)`` directly)."""
+
+    def __init__(self, cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
+                 max_len: int, prompt_max: int, out_max: int,
+                 prefill_chunk: int, block_ticks: int,
+                 eos_id: Optional[int], step_fn, state_specs) -> None:
+        self.cfg = cfg
+        self.mesh = mesh
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.prompt_max = prompt_max
+        self.out_max = out_max
+        self.prefill_chunk = prefill_chunk
+        self.block_ticks = block_ticks
+        self.eos_id = eos_id
+        self.step = step_fn
+        self.state_specs = state_specs
+        self.n_stages = mesh.shape[PIPE_AXIS]
+        self.tp = mesh.shape.get(MODEL_AXIS, 1)
+
+    def sharding(self, key: str):
+        from jax.sharding import NamedSharding
+        return NamedSharding(self.mesh, self.state_specs[key])
+
+    # cache rows past max_len absorb the junk tail of a C-wide write
+    # starting at the last legal offset, so dynamic_update_slice never
+    # clamps (clamping would silently shift valid rows)
+    @property
+    def mlen_alloc(self) -> int:
+        return self.max_len + self.prefill_chunk - 1
+
+    def prepare(self, params) -> tuple:
+        """Pre-stack the layer pytree for the pipe mesh (once per
+        weights, not per block)."""
+        return (stack_stage_layers(params["layers"], self.n_stages, 1),
+                params["embed"], params["head"])
+
+    def init_state(self) -> Dict[str, jax.Array]:
+        cfg, M, C, D = self.cfg, self.n_slots, self.prefill_chunk, \
+            self.n_stages
+        lps = cfg.n_layers // D
+        n_kv = cfg.n_kv_heads or cfg.n_heads
+        dt = jnp.dtype(cfg.dtype)
+        i32 = jnp.int32
+        state = {
+            "u": jnp.zeros((), i32),
+            "h": jnp.zeros((D, 1, C, cfg.dim), dt),
+            "tok_chan": jnp.zeros((D, 1), i32),
+            "meta": jnp.zeros((D, 4), i32),
+            "kc": jnp.zeros((D, lps, M, self.mlen_alloc, n_kv,
+                             cfg.head_dim), dt),
+            "vc": jnp.zeros((D, lps, M, self.mlen_alloc, n_kv,
+                             cfg.head_dim), dt),
+            "tok": jnp.zeros((M,), i32),
+            "pos": jnp.zeros((M,), i32),
+            "prefill_left": jnp.zeros((M,), i32),
+            "emitted": jnp.zeros((M,), i32),
+            "budget": jnp.zeros((M,), i32),
+            "plen": jnp.zeros((M,), i32),
+            "live": jnp.zeros((M,), bool),
+            "finished": jnp.zeros((M,), bool),
+            "prompt_buf": jnp.zeros((M, self.prompt_max + C - 1), i32),
+            "out_buf": jnp.zeros((M, self.out_max), i32),
+            "t_first": jnp.full((M,), -1, i32),
+            "t_finish": jnp.full((M,), -1, i32),
+        }
+        # commit every leaf to its pinned sharding so the step program
+        # compiles exactly once — uncommitted inputs would give the first
+        # call a different signature than steady state
+        return {k: jax.device_put(v, self.sharding(k))
+                for k, v in state.items()}
+
+
+def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
+                         max_len: int, prompt_max: int, out_max: int,
+                         prefill_chunk: int = 1,
+                         block_ticks: Optional[int] = None,
+                         eos_id: Optional[int] = None) -> ServingProgram:
+    """Build the serving tick-block program over ``mesh``'s pipe axis.
+
+    ``n_slots`` is the ring's M (each slot carries one request);
+    ``max_len`` bounds prompt+output per slot; ``prompt_max``/``out_max``
+    size the static prompt/output buffers; ``prefill_chunk`` (C) is how
+    many prompt tokens a slot ingests per visit; ``block_ticks`` is how
+    many ticks one jitted step advances (default M — every slot visited
+    once per block). ``eos_id`` retires a slot the moment it emits that
+    token; budget retirement applies always.
+    """
+    if cfg.arch not in ("gpt2", "llama"):
+        raise ValueError(
+            f"generation is undefined for arch {cfg.arch!r} (see "
+            "models.generate)")
+    D = mesh.shape[PIPE_AXIS]
+    T = mesh.shape.get(MODEL_AXIS, 1)
+    for ax, n in mesh.shape.items():
+        if ax not in (PIPE_AXIS, MODEL_AXIS) and n > 1:
+            raise NotImplementedError(
+                f"the serving executor composes pipe x model meshes; axis "
+                f"{ax!r} has size {n}")
+    _check_tp_divisibility(cfg, T)
+    tp_axis = MODEL_AXIS if T > 1 else None
+    if cfg.n_layers % D:
+        raise ValueError(f"n_layers={cfg.n_layers} must divide over {D} "
+                         "stages")
+    M = n_slots
+    if M < D:
+        raise ValueError(f"n_slots={M} must be >= the pipe degree {D} "
+                         "(fewer slots than stages stalls the ring)")
+    C = prefill_chunk
+    if C < 1:
+        raise ValueError(f"prefill_chunk must be >= 1, got {C}")
+    if prompt_max < 1 or out_max < 1:
+        raise ValueError("prompt_max and out_max must be >= 1")
+    if prompt_max + 1 > max_len:
+        raise ValueError(f"prompt_max ({prompt_max}) + 1 output token "
+                         f"exceeds max_len ({max_len})")
+    mlen_alloc = max_len + C - 1
+    if cfg.arch == "gpt2" and mlen_alloc > cfg.max_seq_len:
+        raise ValueError(f"max_len ({max_len}) + prefill_chunk - 1 "
+                         f"({C - 1}) exceeds the gpt2 position table "
+                         f"(max_seq_len={cfg.max_seq_len})")
+    block = block_ticks or M
+    if block < 1:
+        raise ValueError(f"block_ticks must be >= 1, got {block}")
+    vocab_parallel = tp_axis is not None and cfg.vocab_size % T == 0
+    i32 = jnp.int32
+
+    def spmd(layers_stacked, embed, head, state):
+        d = jax.lax.axis_index(PIPE_AXIS)
+        layers_d = jax.tree.map(lambda x: x[0, 0], layers_stacked)
+        layers_d = compute_cast(cfg, layers_d)
+        embed_c = compute_cast(cfg, embed)
+        head_c = compute_cast(cfg, head)
+        dt = jnp.dtype(cfg.dtype)
+        perm = [(i, (i + 1) % D) for i in range(D)]
+
+        def ring(tree):
+            return jax.tree.map(
+                lambda x: jax.lax.ppermute(x, PIPE_AXIS, perm), tree)
+
+        def tick(carry, _):
+            st = dict(carry)
+            u = st["u"]
+            h_chan, tok_chan, meta = st["h"], st["tok_chan"], st["meta"]
+            kc, vc = st["kc"], st["vc"]
+            is0 = d == 0
+
+            # ---- bank the token that rode in (meta came with it, so a
+            # dead or mid-prefill hop banks nothing). Banking runs BEFORE
+            # the serve so the M == D same-tick arrive/serve case sees
+            # fresh state.
+            bank = is0 & (meta[2] == 1) & (meta[3] == 1)
+            ga = jnp.mod(u - D, M)
+            tk = tok_chan[0]
+            em = st["emitted"][ga]
+            st["out_buf"] = jnp.where(
+                bank, st["out_buf"].at[ga, em].set(tk), st["out_buf"])
+            st["t_first"] = jnp.where(
+                bank & (em == 0), st["t_first"].at[ga].set(u), st["t_first"])
+            em2 = em + 1
+            fin_now = em2 >= st["budget"][ga]
+            if eos_id is not None:
+                fin_now = fin_now | (tk == eos_id)
+            st["finished"] = jnp.where(
+                bank, st["finished"].at[ga].set(st["finished"][ga] | fin_now),
+                st["finished"])
+            st["t_finish"] = jnp.where(
+                bank & fin_now, st["t_finish"].at[ga].set(u), st["t_finish"])
+            st["emitted"] = jnp.where(
+                bank, st["emitted"].at[ga].set(em2), st["emitted"])
+            st["tok"] = jnp.where(bank, st["tok"].at[ga].set(tk), st["tok"])
+
+            # ---- serve slot g = u mod M. Stage 0 builds the metadata
+            # from its slot tables; later stages replay the copy that
+            # rode in with the activations.
+            g = jnp.mod(u, M)
+            act0 = st["live"][g] & ~st["finished"][g]
+            pleft = st["prefill_left"][g]
+            ispre = pleft > 0
+            sv0 = jnp.where(ispre, jnp.minimum(pleft, C), 1)
+            off0 = st["pos"][g]
+            sf0 = jnp.where(ispre, (pleft <= C).astype(i32), 1)
+            meta0 = jnp.stack([off0, sv0, sf0, act0.astype(i32)])
+            meta_eff = jnp.where(is0, meta0, meta)
+            offset, s_valid = meta_eff[0], meta_eff[1]
+            active = meta_eff[3] == 1
+
+            # stage 0 consumes the slot's frontier for this visit
+            upd = is0 & act0
+            st["pos"] = jnp.where(upd, st["pos"].at[g].set(off0 + sv0),
+                                  st["pos"])
+            st["prefill_left"] = jnp.where(
+                upd & ispre,
+                st["prefill_left"].at[g].set(pleft - sv0),
+                st["prefill_left"])
+
+            # the C-token input: next prompt chunk while prefilling, the
+            # last banked token (plus C-1 junk rows) while decoding. The
+            # junk rows' cache writes land past the valid frontier and
+            # are overwritten before the frontier reaches them.
+            pstart = st["plen"][g] - pleft
+            chunk = jax.lax.dynamic_slice(st["prompt_buf"][g],
+                                          (jnp.maximum(pstart, 0),), (C,))
+            dec = jnp.zeros((C,), i32).at[0].set(st["tok"][g])
+            toks_in = jnp.where(ispre, chunk, dec)[None]  # [1, C]
+            x0 = _embed_at(cfg, embed_c, toks_in, offset).astype(dt)
+            x = jnp.where(is0, x0, h_chan)
+
+            def unit(op):
+                kc, vc = op
+                y, kc, vc = _slot_cache_apply(cfg, layers_d, x, kc, vc, g, 1,
+                                              offset, C, tp_axis=tp_axis,
+                                              tp_size=T)
+                y_last = jax.lax.dynamic_slice_in_dim(y, s_valid - 1, 1,
+                                                      axis=1)
+                tok = jax.lax.cond(
+                    (d == D - 1) & (meta_eff[2] == 1),
+                    lambda: _head_token(cfg, head_c, embed_c, y_last, None,
+                                        tp_axis=tp_axis, tp_size=T,
+                                        vocab_parallel=vocab_parallel),
+                    lambda: jnp.zeros((1,), i32))
+                return (kc, vc), y, tok
+
+            def noop(op):
+                return op, jnp.zeros_like(h_chan), jnp.zeros((1,), i32)
+
+            (kc, vc), y, tok = jax.lax.cond(active, unit, noop, (kc, vc))
+            st["h"], st["tok_chan"], st["meta"] = ring((y, tok, meta_eff))
+            st["kc"], st["vc"] = kc, vc
+            st["u"] = u + 1
+            return st, None
+
+        # per-device leaves arrive with a leading singleton shard dim
+        inner = dict(state)
+        for k in ("h", "tok_chan", "meta", "kc", "vc"):
+            inner[k] = state[k][0]
+        inner, _ = jax.lax.scan(tick, inner, None, length=block)
+
+        # stage 0's slot tables are authoritative; replicate them so the
+        # host (and the next block on every stage) sees one truth
+        out = dict(inner)
+        for k in ("tok", "pos", "prefill_left", "emitted", "finished",
+                  "out_buf", "t_first", "t_finish"):
+            v = inner[k]
+            rep = jax.lax.psum(jnp.where(d == 0, v.astype(i32), 0), PIPE_AXIS)
+            out[k] = rep.astype(v.dtype)
+        for k in ("h", "tok_chan", "meta", "kc", "vc"):
+            out[k] = out[k][None]
+        return out
+
+    layer_spec = (_dense_layer_specs(cfg, T, None) if T > 1
+                  else P(PIPE_AXIS))
+    cache_spec = (P(PIPE_AXIS, None, None, None, MODEL_AXIS) if T > 1
+                  else P(PIPE_AXIS))
+    state_spec = {
+        "u": P(), "h": P(PIPE_AXIS), "tok_chan": P(PIPE_AXIS),
+        "meta": P(PIPE_AXIS), "kc": cache_spec, "vc": cache_spec,
+        "tok": P(), "pos": P(), "prefill_left": P(), "emitted": P(),
+        "budget": P(), "plen": P(), "live": P(), "finished": P(),
+        "prompt_buf": P(), "out_buf": P(), "t_first": P(), "t_finish": P(),
+    }
+    sharded = _shard_map(spmd, mesh,
+                         in_specs=(layer_spec, P(), P(), state_spec),
+                         out_specs=state_spec)
+
+    # donate the state (caches included): the block is state -> state', so
+    # XLA reuses the cache buffers instead of double-allocating them
+    step = jax.jit(sharded, donate_argnums=(3,))
+
+    return ServingProgram(cfg, mesh, n_slots=M, max_len=max_len,
+                          prompt_max=prompt_max, out_max=out_max,
+                          prefill_chunk=C, block_ticks=block, eos_id=eos_id,
+                          step_fn=step, state_specs=state_spec)
+
+
+class ServingEngine:
+    """Host-side scheduler driving a :class:`ServingProgram`.
+
+    ``submit`` queues requests; ``run`` (or repeated ``run_block``)
+    advances the ring in jitted blocks, retiring finished slots and
+    admitting queued requests between blocks. ``report`` (optional
+    :class:`...utils.telemetry.RunReport`) receives one event per
+    admission/completion for the crash-safe JSONL stream.
+    """
+
+    def __init__(self, program: ServingProgram, params, *,
+                 report=None) -> None:
+        self.program = program
+        self.weights = program.prepare(params)
+        self.report = report
+        self.reset()
+
+    def reset(self) -> None:
+        self.state = self.program.init_state()
+        # numpy mirrors of the scheduler-owned leaves: the host mutates
+        # THESE (plain array writes — no per-slot jitted updates to
+        # compile), and only dirty keys get re-uploaded before a block
+        self.host: Dict[str, np.ndarray] = {
+            k: np.array(self.state[k]) for k in _SCHED_KEYS}
+        self._dirty: set = set()
+        self.pending: deque = deque()
+        self.waiting: deque = deque()
+        self.completions: List[Completion] = []
+        self.occupancy: List[Any] = []
+        self._slot_req: Dict[int, Request] = {}
+        self._slot_admit: Dict[int, int] = {}
+        self._tick = 0
+
+    # -- request intake --------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        """Validate and queue one request (ordered by ``arrival``)."""
+        p = self.program
+        plen = len(req.prompt)
+        if plen < 1 or plen > p.prompt_max:
+            raise ValueError(f"request {req.rid}: prompt length {plen} "
+                             f"outside [1, prompt_max={p.prompt_max}]")
+        if req.max_new_tokens < 1 or req.max_new_tokens > p.out_max:
+            raise ValueError(f"request {req.rid}: max_new_tokens="
+                             f"{req.max_new_tokens} outside [1, out_max="
+                             f"{p.out_max}]")
+        if plen + req.max_new_tokens > p.max_len:
+            raise ValueError(
+                f"request {req.rid}: prompt ({plen}) + budget "
+                f"({req.max_new_tokens}) overflows the slot max_len "
+                f"({p.max_len})")
+        self.pending.append(req)
+
+    # -- scheduling ------------------------------------------------------
+
+    def _admit(self, slot: int, req: Request) -> None:
+        # plain numpy writes on the host mirrors: per-slot jnp ``.at[]``
+        # updates would each compile a one-off XLA program per
+        # (field, slot) pair and dominate CPU wall-clock
+        h, p = self.host, self.program
+        plen = len(req.prompt)
+        h["prompt_buf"][slot] = 0
+        h["prompt_buf"][slot, :plen] = np.asarray(req.prompt, np.int32)
+        h["plen"][slot] = plen
+        h["prefill_left"][slot] = plen
+        h["pos"][slot] = 0
+        h["emitted"][slot] = 0
+        h["budget"][slot] = req.max_new_tokens
+        h["tok"][slot] = 0
+        h["out_buf"][slot] = 0
+        h["t_first"][slot] = -1
+        h["t_finish"][slot] = -1
+        h["finished"][slot] = False
+        h["live"][slot] = True
+        self._dirty.update(("prompt_buf", "plen", "prefill_left", "pos",
+                            "emitted", "budget", "tok", "out_buf", "t_first",
+                            "t_finish", "finished", "live"))
+        self._slot_req[slot] = req
+        self._slot_admit[slot] = self._tick
+        if self.report is not None:
+            self.report.event("serve_admit", rid=req.rid, slot=slot,
+                              tick=self._tick, prompt_len=plen,
+                              budget=req.max_new_tokens)
+
+    def _harvest(self) -> None:
+        host = self.host
+        for slot, req in list(self._slot_req.items()):
+            if not host["finished"][slot]:
+                continue
+            n = int(host["emitted"][slot])
+            comp = Completion(
+                rid=req.rid, prompt=list(map(int, req.prompt)),
+                tokens=[int(t) for t in host["out_buf"][slot][:n]],
+                slot=slot, admit_tick=self._slot_admit[slot],
+                first_token_tick=int(host["t_first"][slot]),
+                finish_tick=int(host["t_finish"][slot]),
+                arrival=req.arrival)
+            self.completions.append(comp)
+            host["live"][slot] = False
+            self._dirty.add("live")
+            del self._slot_req[slot]
+            del self._slot_admit[slot]
+            if self.report is not None:
+                self.report.event("serve_finish", rid=req.rid, slot=slot,
+                                  tick=self._tick, n_tokens=n,
+                                  ttft_ticks=comp.ttft_ticks)
+
+    def run(self, requests: Sequence[Request], *,
+            policy: str = "continuous",
+            max_blocks: int = 200_000) -> ServeResult:
+        """Serve ``requests`` to completion and return the
+        :class:`ServeResult`. ``policy="continuous"`` refills freed
+        slots immediately; ``policy="static"`` admits a fresh batch only
+        once every slot has drained (the fill-drain baseline)."""
+        if policy not in ("continuous", "static"):
+            raise ValueError(f"unknown policy {policy!r} (continuous|static)")
+        self.reset()
+        for r in sorted(requests, key=lambda r: r.arrival):
+            self.submit(r)
+        p = self.program
+        free = list(range(p.n_slots))
+        wall0 = time.perf_counter()
+        for _ in range(max_blocks):
+            while self.pending and self.pending[0].arrival <= self._tick:
+                self.waiting.append(self.pending.popleft())
+            if policy == "continuous" or len(free) == p.n_slots:
+                while free and self.waiting:
+                    self._admit(free.pop(0), self.waiting.popleft())
+            if not self._slot_req:
+                if not self.waiting and not self.pending:
+                    break  # drained
+                if not self.waiting:
+                    # idle gap before the next arrival: nothing is in
+                    # flight (all slots dead => all ring hops dead), so
+                    # jumping the tick counter is observationally the
+                    # same as spinning empty blocks
+                    nxt = int(np.ceil(self.pending[0].arrival))
+                    self._tick = max(self._tick, nxt)
+                    self.host["u"] = np.asarray(self._tick, np.int32)
+                    self._dirty.add("u")
+                    continue
+            # upload only the leaves the scheduler touched, in one batched
+            # transfer, each pinned to its spec so the jitted block sees
+            # one stable signature
+            if self._dirty:
+                dirty = sorted(self._dirty)
+                vals = jax.device_put([self.host[k] for k in dirty],
+                                      [p.sharding(k) for k in dirty])
+                self.state.update(zip(dirty, vals))
+                self._dirty.clear()
+            self.state = p.step(*self.weights, self.state)
+            fetched = jax.device_get({k: self.state[k] for k in _HOST_KEYS})
+            self.host.update(  # np.array: device_get views can be read-only
+                {k: np.array(v) for k, v in fetched.items()})
+            self._tick = int(self.host["u"])
+            n_active = int((self.host["live"] & ~self.host["finished"]).sum())
+            self.occupancy.append((self._tick, n_active))
+            self._harvest()
+            free = [g for g in range(p.n_slots) if g not in self._slot_req]
+        else:
+            raise RuntimeError(f"serving did not drain within {max_blocks} "
+                               "blocks — check arrivals/budgets")
+        wall = time.perf_counter() - wall0
+        return ServeResult(completions=self.completions,
+                           occupancy=self.occupancy, ticks=self._tick,
+                           wall_s=wall, n_slots=p.n_slots, policy=policy)

@@ -260,6 +260,55 @@ class PipelineTelemetry:
 
 
 # ---------------------------------------------------------------------------
+# Serving latency summaries
+# ---------------------------------------------------------------------------
+
+
+def _pct(xs: Sequence[float]) -> Dict[str, float]:
+    """p50/p95/p99/mean of a latency sample (empty-safe)."""
+    if not len(xs):
+        return {"p50": None, "p95": None, "p99": None, "mean": None, "n": 0}
+    a = np.asarray(xs, np.float64)
+    return {"p50": float(np.percentile(a, 50)),
+            "p95": float(np.percentile(a, 95)),
+            "p99": float(np.percentile(a, 99)),
+            "mean": float(a.mean()), "n": int(a.size)}
+
+
+def serving_summary(result) -> Dict[str, Any]:
+    """Per-request latency + throughput summary of one serving run.
+
+    ``result`` is a :class:`...serving.engine.ServeResult` (duck-typed —
+    anything with ``completions`` carrying ``ttft_ticks``/``tpot_ticks``,
+    plus ``tokens_out``/``ticks``/``wall_s``/``n_slots``/``policy``/
+    ``occupancy``). Latencies are reported in *ticks* (exact, stamped
+    on-device at token-banking time) with the measured ``s_per_tick``
+    factor alongside, so wall-clock latencies are one multiply away and
+    the tick numbers stay comparable across hosts.
+    """
+    ttfts = [c.ttft_ticks for c in result.completions]
+    tpots = [c.tpot_ticks for c in result.completions
+             if c.tpot_ticks is not None]
+    occ = [int(n) for _, n in result.occupancy]
+    return {
+        "policy": result.policy,
+        "n_requests": len(result.completions),
+        "n_slots": int(result.n_slots),
+        "ticks": int(result.ticks),
+        "wall_s": float(result.wall_s),
+        "s_per_tick": (float(result.wall_s) / result.ticks
+                       if result.ticks else None),
+        "tokens_out": int(result.tokens_out),
+        "tokens_per_sec": float(result.tokens_per_sec),
+        "goodput": float(result.goodput),
+        "ttft_ticks": _pct(ttfts),
+        "tpot_ticks": _pct(tpots),
+        "occupancy_mean": float(np.mean(occ)) if occ else 0.0,
+        "occupancy": [[int(t), int(n)] for t, n in result.occupancy],
+    }
+
+
+# ---------------------------------------------------------------------------
 # Structured run reports
 # ---------------------------------------------------------------------------
 
@@ -289,6 +338,7 @@ class RunReport:
         self.timers: Dict[str, float] = {}
         self.events: List[Dict[str, Any]] = []
         self.telemetry: Optional[Dict[str, Any]] = None
+        self.serving: List[Dict[str, Any]] = []
         self.out_dir = out_dir
         self._events_fh = None
         if out_dir is not None:
@@ -333,6 +383,13 @@ class RunReport:
         """Embed a measured-timeline section (:meth:`PipelineTelemetry.report`)."""
         self.telemetry = telemetry.report()
 
+    def attach_serving(self, summary: Dict[str, Any]) -> None:
+        """Append one serving-run latency summary
+        (:func:`serving_summary`) to the manifest's ``serving`` list —
+        a benchmark that runs continuous and static policies back to
+        back attaches both."""
+        self.serving.append(summary)
+
     # -- output ---------------------------------------------------------
 
     def manifest(self) -> Dict[str, Any]:
@@ -350,6 +407,8 @@ class RunReport:
             out["events"] = _jsonable(self.events)
         if self.telemetry is not None:
             out["telemetry"] = _jsonable(self.telemetry)
+        if self.serving:
+            out["serving"] = _jsonable(self.serving)
         return out
 
     def write(self, path: Optional[str] = None) -> Dict[str, Any]:
@@ -442,3 +501,22 @@ def validate_report(manifest: Dict[str, Any]) -> None:
                     isinstance(r, dict) and "duration_s" in r
                     and "n_ticks" in r for r in tel["timeline"]):
                 fail("telemetry.timeline rows need duration_s and n_ticks")
+    serving = manifest.get("serving")
+    if serving is not None:
+        if not isinstance(serving, list):
+            fail("serving must be a list of run summaries")
+        for row in serving:
+            if not isinstance(row, dict):
+                fail("each serving summary must be a dict")
+            if not isinstance(row.get("policy"), str):
+                fail("serving summary needs a str 'policy'")
+            for key in ("tokens_out", "ticks", "n_requests"):
+                if not isinstance(row.get(key), int):
+                    fail(f"serving summary needs an int {key!r}")
+            for key in ("wall_s", "tokens_per_sec", "goodput"):
+                if not isinstance(row.get(key), (int, float)):
+                    fail(f"serving summary needs a numeric {key!r}")
+            for key in ("ttft_ticks", "tpot_ticks"):
+                if not isinstance(row.get(key), dict):
+                    fail(f"serving summary needs a dict {key!r} "
+                         "(p50/p95/p99/mean)")

@@ -24,6 +24,15 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
   exit 1
 fi
 echo "TELEMETRY_SMOKE=ok"
+# Serving liveness next (same discipline): a small continuous-batching
+# run must bit-match the single-device oracle and produce a validated
+# report with TTFT/TPOT rows. Lands in /tmp/serve_smoke for CI upload.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python scripts/serve_smoke.py /tmp/serve_smoke; then
+  echo "SERVE_SMOKE=fail"
+  exit 1
+fi
+echo "SERVE_SMOKE=ok"
 rm -f /tmp/_t1.log
 timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \

@@ -78,6 +78,36 @@ def test_top_p_and_top_k_truncate_support():
     assert set(map(int, draws_p)) <= {0, 1}  # 0.5+0.3 >= 0.75 closes the nucleus
 
 
+@pytest.mark.parametrize("cfg", [GPT2, LLAMA], ids=["gpt2", "llama-gqa"])
+def test_eos_freezes_streams_and_reports_lengths(cfg):
+    """EOS-aware decode: once a row is about to consume EOS it freezes —
+    the EOS token's KV never enters the cache, every later emitted token
+    is forced to eos_id — and per-row lengths count through the first
+    EOS. Ground truth is the no-cache greedy run truncated by hand."""
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (3, 4), 0,
+                                cfg.vocab_size)
+    N = 10
+    ref = _greedy_no_cache(cfg, params, prompt, N)[:, 4:]
+    # an eos that actually fires for at least one row: the most common
+    # token in the reference streams (random-init greedy repeats a lot)
+    vals, counts = jnp.unique(ref, return_counts=True)
+    eos = int(vals[jnp.argmax(counts)])
+    out, lengths = generate(cfg, params, prompt, N, eos_id=eos,
+                            return_lengths=True)
+    new = jnp.asarray(out)[:, 4:]
+    for b in range(3):
+        row_ref = [int(t) for t in ref[b]]
+        n = row_ref.index(eos) + 1 if eos in row_ref else N
+        assert int(lengths[b]) == n, (b, lengths, row_ref)
+        # up to the first EOS: bit-match the unfrozen run; after: eos fill
+        assert [int(t) for t in new[b][:n]] == row_ref[:n]
+        assert all(int(t) == eos for t in new[b][n:])
+    assert lengths.dtype == jnp.int32
+    with pytest.raises(ValueError, match="eos_id"):
+        generate(cfg, params, prompt, N, return_lengths=True)
+
+
 def test_invalid_lengths_rejected():
     params = tfm.transformer_init(jax.random.key(0), GPT2)
     prompt = jnp.zeros((1, 60), jnp.int32)

@@ -73,6 +73,48 @@ def test_pipelined_decode_sampling_and_errors():
             cfg, mesh, cfg.max_seq_len + 1)(params, prompt)
 
 
+@pytest.mark.parametrize("D,n_streams", [(2, 2), (2, 3)])
+def test_pipelined_eos_matches_single_device(D, n_streams):
+    """EOS-aware ring decode: frozen streams (masked cache writes, eos
+    fill) and per-request lengths must bit-match the single-device
+    ``generate`` with the same eos_id — at M = D and M > D."""
+    cfg = _cfg("gpt2")
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    B, P, N = 2 * n_streams, 4, 8
+    prompt = jax.random.randint(jax.random.key(1), (B, P), 0,
+                                cfg.vocab_size)
+    plain = jnp.asarray(generate(cfg, params, prompt, N))[:, P:]
+    vals, counts = jnp.unique(plain, return_counts=True)
+    eos = int(vals[jnp.argmax(counts)])  # an eos that actually fires
+    want, want_len = generate(cfg, params, prompt, N, eos_id=eos,
+                              return_lengths=True)
+    gen = make_pipeline_generate_fn(cfg, make_mesh(n_pipe=D), N,
+                                    n_streams=n_streams, eos_id=eos,
+                                    return_lengths=True)
+    got, got_len = gen(params, prompt)
+    assert (jnp.asarray(got) == jnp.asarray(want)).all(), (
+        got.tolist(), want.tolist())
+    assert (jnp.asarray(got_len) == jnp.asarray(want_len)).all(), (
+        got_len.tolist(), want_len.tolist())
+    assert int(jnp.min(got_len)) < N  # the chosen eos did fire
+
+
+def test_pipelined_decode_eos_validation():
+    cfg = _cfg("gpt2")
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    mesh = make_mesh(n_pipe=2)
+    with pytest.raises(ValueError, match="eos_id"):
+        make_pipeline_generate_fn(cfg, mesh, 4, return_lengths=True)
+    gen = make_pipeline_generate_fn(cfg, mesh, 4, n_streams=3)
+    prompt = jax.random.randint(jax.random.key(1), (4, 4), 0,
+                                cfg.vocab_size)
+    with pytest.raises(ValueError, match="divisible"):
+        gen(params, prompt)  # batch 4 over 3 round-robin streams
+    with pytest.raises(ValueError, match="max_len"):
+        make_pipeline_generate_fn(cfg, mesh, 8, max_len=8)(
+            params, prompt)  # 4 + 8 > 8
+
+
 @pytest.mark.parametrize("arch,kw", [
     ("gpt2", {}),
     ("llama", dict(n_kv_heads=2)),

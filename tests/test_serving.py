@@ -1,0 +1,229 @@
+"""Continuous-batching serving (ISSUE 7): slot-level admission over the
+pipelined round-robin decoder. The load-bearing property is ORACLE
+PARITY — every admitted request's greedy tokens must bit-match the
+single-device ``models.generate`` run of that request alone, including
+requests admitted mid-flight into recycled slots — plus EOS/budget
+retirement, the static fill-drain baseline emitting identical tokens in
+at least as many ticks, and actionable build/submit validation."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.models import (
+    transformer as tfm)
+from distributed_training_with_pipeline_parallelism_tpu.models.generate import (
+    generate)
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+    make_mesh)
+from distributed_training_with_pipeline_parallelism_tpu.serving import (
+    Request, ServingEngine, make_serving_step_fn)
+from distributed_training_with_pipeline_parallelism_tpu.serving.bench import (
+    synth_trace)
+from distributed_training_with_pipeline_parallelism_tpu.utils.telemetry import (
+    RunReport, serving_summary, validate_report)
+
+EOS = 7
+
+
+def _cfg(arch="gpt2", **kw):
+    base = dict(dim=32, n_layers=4, n_heads=4, vocab_size=64, ffn_dim=64,
+                max_seq_len=64, arch=arch)
+    base.update(kw)
+    return dtpp.ModelConfig(**base)
+
+
+def _requests(cfg, n, seed=0, prompt_max=8, out_max=10, spacing=2.0):
+    rng = np.random.RandomState(seed)
+    return [Request(rid=i,
+                    prompt=rng.randint(0, cfg.vocab_size,
+                                       size=int(rng.randint(1, prompt_max)))
+                    .tolist(),
+                    max_new_tokens=int(rng.randint(1, out_max + 1)),
+                    arrival=float(i) * spacing)
+            for i in range(n)]
+
+
+def _assert_oracle_parity(cfg, params, program, completions, budgets):
+    for c in completions:
+        want_toks, want_len = generate(
+            cfg, params, np.asarray([c.prompt], np.int32),
+            max_new_tokens=budgets[c.rid], eos_id=EOS, return_lengths=True,
+            max_len=program.mlen_alloc)
+        n = int(want_len[0])
+        want = [int(t) for t in
+                np.asarray(want_toks)[0][len(c.prompt):len(c.prompt) + n]]
+        assert c.tokens == want, (c.rid, c.slot, c.tokens, want)
+
+
+@pytest.mark.parametrize("arch,kw", [
+    ("gpt2", {}),
+    ("llama", dict(n_kv_heads=2)),
+])
+@pytest.mark.parametrize("D,M,C", [(2, 3, 2), (2, 2, 1)])
+def test_serving_oracle_parity_recycled_slots(arch, kw, D, M, C):
+    """More requests than slots with staggered arrivals: retired slots
+    are recycled mid-flight, and every request still bit-matches the
+    single-device oracle (chunked prefill included)."""
+    cfg = _cfg(arch, **kw)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    program = make_serving_step_fn(cfg, make_mesh(n_pipe=D), n_slots=M,
+                                   max_len=24, prompt_max=8, out_max=10,
+                                   prefill_chunk=C, eos_id=EOS)
+    engine = ServingEngine(program, params)
+    requests = _requests(cfg, 2 * M + 1, seed=3)
+    res = engine.run(requests, policy="continuous")
+    assert len(res.completions) == len(requests)
+    by_slot = {}
+    for c in res.completions:
+        by_slot.setdefault(c.slot, []).append(c.rid)
+    assert any(len(v) > 1 for v in by_slot.values()), by_slot  # recycled
+    _assert_oracle_parity(cfg, params, program,
+                          res.completions,
+                          {r.rid: r.max_new_tokens for r in requests})
+    # tick-exact latency stamps: the ring's first token returns D ticks
+    # after its serve, and a slot is revisited every M ticks
+    for c in res.completions:
+        assert c.first_token_tick - c.admit_tick >= D
+        if c.tpot_ticks is not None:
+            assert c.tpot_ticks == M
+
+
+def test_serving_eos_retires_early():
+    """A request whose greedy stream hits EOS frees its slot before the
+    budget: pick the oracle's own 3rd generated token as the eos_id so
+    retirement is guaranteed, and check the freed slot is reused."""
+    cfg = _cfg()
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    prompt = [5, 11, 2]
+    plain = [int(t) for t in
+             np.asarray(generate(cfg, params,
+                                 np.asarray([prompt], np.int32), 8))[0][3:]]
+    # first value whose first occurrence is past index 0, so the stream
+    # decodes a few ticks before retiring (greedy at random init repeats
+    # tokens; plain[k] for a fixed k may already equal plain[0])
+    cand = [v for i, v in enumerate(plain) if i >= 1 and v not in plain[:i]]
+    eos = cand[0] if cand else plain[0]
+    k = plain.index(eos)
+    program = make_serving_step_fn(cfg, make_mesh(n_pipe=2), n_slots=2,
+                                   max_len=20, prompt_max=6, out_max=8,
+                                   prefill_chunk=1, eos_id=eos)
+    engine = ServingEngine(program, params)
+    res = engine.run([Request(rid=0, prompt=prompt, max_new_tokens=8)],
+                     policy="continuous")
+    (c,) = res.completions
+    assert len(c.tokens) == k + 1 < 8  # k tokens + the EOS, budget was 8
+    assert c.tokens[-1] == eos
+    assert c.tokens == plain[:k + 1]
+
+
+def test_serving_static_policy_matches_and_is_no_faster():
+    """Same compiled block, same trace: the fill-drain baseline must
+    emit identical per-request tokens and take >= the ticks (that gap is
+    the benchmark's headline)."""
+    cfg = _cfg()
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    program = make_serving_step_fn(cfg, make_mesh(n_pipe=2), n_slots=3,
+                                   max_len=24, prompt_max=8, out_max=8,
+                                   prefill_chunk=2, eos_id=EOS)
+    engine = ServingEngine(program, params)
+    trace = synth_trace(8, prompt_lens=(1, 8), out_lens=(1, 8),
+                        prefill_chunk=2, load=1.5,
+                        vocab_size=cfg.vocab_size, seed=1)
+    cont = engine.run(trace, policy="continuous")
+    stat = engine.run(trace, policy="static")
+    by_rid = {c.rid: c.tokens for c in stat.completions}
+    assert all(by_rid[c.rid] == c.tokens for c in cont.completions)
+    assert stat.ticks >= cont.ticks
+    assert cont.tokens_out == stat.tokens_out > 0
+
+
+def test_serving_telemetry_report(tmp_path):
+    """TTFT/TPOT land in the RunReport ``serving`` section and the
+    manifest still validates; admissions/completions hit the JSONL
+    event stream."""
+    cfg = _cfg()
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    program = make_serving_step_fn(cfg, make_mesh(n_pipe=2), n_slots=2,
+                                   max_len=20, prompt_max=6, out_max=6,
+                                   prefill_chunk=1, eos_id=EOS)
+    report = RunReport(out_dir=str(tmp_path), name="serve_test")
+    engine = ServingEngine(program, params, report=report)
+    res = engine.run(_requests(cfg, 3, seed=5, prompt_max=6, out_max=6),
+                     policy="continuous")
+    report.attach_serving(serving_summary(res))
+    manifest = report.write()
+    validate_report(manifest)
+    (row,) = manifest["serving"]
+    assert row["policy"] == "continuous"
+    assert row["n_requests"] == 3
+    assert row["tokens_out"] == res.tokens_out
+    assert row["ttft_ticks"]["p50"] is not None
+    assert row["occupancy_mean"] > 0
+    events = (tmp_path / "events.jsonl").read_text()
+    assert "serve_admit" in events and "serve_finish" in events
+
+
+def test_serving_build_and_submit_validation():
+    cfg = _cfg()
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    mesh = make_mesh(n_pipe=2)
+    with pytest.raises(ValueError, match="pipe degree"):
+        make_serving_step_fn(cfg, mesh, n_slots=1, max_len=24,
+                             prompt_max=8, out_max=8)
+    with pytest.raises(ValueError, match="prompt_max"):
+        make_serving_step_fn(cfg, mesh, n_slots=2, max_len=8,
+                             prompt_max=8, out_max=8)
+    with pytest.raises(ValueError, match="position table"):
+        make_serving_step_fn(cfg, mesh, n_slots=2,
+                             max_len=cfg.max_seq_len + 4,
+                             prompt_max=8, out_max=8)
+    with pytest.raises(NotImplementedError, match="pipe x model"):
+        make_serving_step_fn(cfg, make_mesh(n_pipe=2, n_data=2),
+                             n_slots=2, max_len=24, prompt_max=8,
+                             out_max=8)
+    program = make_serving_step_fn(cfg, mesh, n_slots=2, max_len=12,
+                                   prompt_max=8, out_max=8, eos_id=EOS)
+    engine = ServingEngine(program, params)
+    with pytest.raises(ValueError, match="prompt length"):
+        engine.submit(Request(rid=0, prompt=list(range(9)),
+                              max_new_tokens=2))
+    with pytest.raises(ValueError, match="out_max"):
+        engine.submit(Request(rid=1, prompt=[1], max_new_tokens=9))
+    with pytest.raises(ValueError, match="overflows the slot max_len"):
+        engine.submit(Request(rid=2, prompt=list(range(8)),
+                              max_new_tokens=8))
+    with pytest.raises(ValueError, match="policy"):
+        engine.run([Request(rid=3, prompt=[1], max_new_tokens=1)],
+                   policy="clairvoyant")
+
+
+def test_serving_tp_oracle_parity():
+    """pipe x model: Megatron TP inside each serving stage (vocab-
+    parallel greedy head) still bit-matches the single-device oracle."""
+    cfg = _cfg()
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    program = make_serving_step_fn(cfg, make_mesh(n_pipe=2, n_model=2),
+                                   n_slots=2, max_len=20, prompt_max=6,
+                                   out_max=6, prefill_chunk=2, eos_id=EOS)
+    engine = ServingEngine(program, params)
+    requests = _requests(cfg, 3, seed=9, prompt_max=6, out_max=6)
+    res = engine.run(requests, policy="continuous")
+    assert len(res.completions) == len(requests)
+    _assert_oracle_parity(cfg, params, program, res.completions,
+                          {r.rid: r.max_new_tokens for r in requests})
+
+
+def test_synth_trace_shape():
+    trace = synth_trace(16, prompt_lens=(2, 12), out_lens=(2, 16),
+                        prefill_chunk=2, load=1.5, vocab_size=64, seed=0)
+    assert len(trace) == 16
+    assert trace[0].arrival == 0.0
+    arr = [r.arrival for r in trace]
+    assert arr == sorted(arr)
+    assert all(2 <= len(r.prompt) <= 12 for r in trace)
+    assert all(2 <= r.max_new_tokens <= 16 for r in trace)
+    with pytest.raises(ValueError, match="load"):
+        synth_trace(4, load=0.0)
