@@ -50,6 +50,7 @@ exits 0 even when the accelerator never comes up (see ``_init_backend``).
 """
 
 import json
+import math
 import os
 import sys
 import time
@@ -173,7 +174,10 @@ def _time_step(step, params, tokens, targets, num_iterations):
             loss, grads = step(params, tokens, targets)
         force_completion(loss)
         elapsed_runs.append(time.perf_counter() - start)
-    return sorted(elapsed_runs)[1], compile_s
+    # the loss is already on the host (the fetch above IS the barrier):
+    # report it so a diverged/NaN config is flagged instead of publishing
+    # a throughput number for garbage math
+    return sorted(elapsed_runs)[1], compile_s, float(loss)
 
 
 def run_config(cfg, batch_size, seq_length, num_iterations=20,
@@ -192,16 +196,21 @@ def run_config(cfg, batch_size, seq_length, num_iterations=20,
                                 0, cfg.vocab_size)
     targets = jax.random.randint(jax.random.key(2), (batch_size, seq_length),
                                  0, cfg.vocab_size)
-    elapsed, compile_s = _time_step(step, params, tokens, targets,
-                                    num_iterations)
+    elapsed, compile_s, last_loss = _time_step(step, params, tokens, targets,
+                                               num_iterations)
     tokens_processed = batch_size * seq_length * num_iterations
     throughput = tokens_processed / elapsed
     flops_tok = train_flops_per_token(cfg, seq_length)
     mfu = throughput * flops_tok / (chip_peak_flops() * n_pipe)
-    return {"tokens_per_sec": round(throughput, 2),
-            "mfu": round(mfu, 4),
-            "elapsed_s": round(elapsed, 3),
-            "compile_s": round(compile_s, 2)}
+    row = {"tokens_per_sec": round(throughput, 2),
+           "mfu": round(mfu, 4),
+           "elapsed_s": round(elapsed, 3),
+           "compile_s": round(compile_s, 2)}
+    if not math.isfinite(last_loss):
+        # a benchmark number for a program computing NaNs is meaningless —
+        # flag it loudly in the row rather than failing the whole sweep
+        row["anomaly"] = f"non-finite loss ({last_loss}) after timed window"
+    return row
 
 
 def _result(headline, extra, n_pipe) -> dict:

@@ -22,6 +22,8 @@ from .utils.config import (MeshConfig, ModelConfig, RunConfig, ScheduleConfig,
 #   dtpp.fsdp_shard_params(...)      pp x fsdp resting placement
 #   dtpp.fit(...)                    training loop (optax + orbax)
 #   dtpp.ServingEngine(...)          continuous-batching serving (docs/serving.md)
+#   dtpp.CheckpointManager(...)      crash-safe checkpoints (docs/resilience.md)
+#   dtpp.AnomalyGuard / FaultPlan    anomaly guard + fault injection
 _LAZY = {
     "make_mesh": ("parallel.mesh", "make_mesh"),
     "init_multihost": ("parallel.mesh", "init_multihost"),
@@ -41,6 +43,9 @@ _LAZY = {
     "run_all_experiments": ("utils.sweep", "run_all_experiments"),
     "run_one_experiment": ("utils.sweep", "run_one_experiment"),
     "MoEConfig": ("models.moe", "MoEConfig"),
+    "AnomalyGuard": ("utils.resilience", "AnomalyGuard"),
+    "CheckpointManager": ("utils.resilience", "CheckpointManager"),
+    "FaultPlan": ("utils.resilience", "FaultPlan"),
     "Request": ("serving", "Request"),
     "ServingEngine": ("serving", "ServingEngine"),
     "make_serving_step_fn": ("serving", "make_serving_step_fn"),

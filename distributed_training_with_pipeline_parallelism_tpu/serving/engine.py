@@ -91,7 +91,11 @@ class Completion:
     ``tokens`` includes the EOS token when the request ended on one.
     ``ttft_ticks`` counts from *arrival* (queue wait included);
     ``tpot_ticks`` is the mean tick gap between consecutive output
-    tokens (None for single-token outputs)."""
+    tokens (None for single-token outputs). A request the scheduler
+    retired without serving (over-budget prompt, poisoned admission)
+    comes back with ``status="failed"``, a ``reason``, no tokens and
+    ``-1`` stamps — per-request failure is an outcome, not an engine
+    crash (docs/resilience.md)."""
     rid: int
     prompt: List[int]
     tokens: List[int]
@@ -100,6 +104,8 @@ class Completion:
     first_token_tick: int
     finish_tick: int
     arrival: float
+    status: str = "ok"
+    reason: Optional[str] = None
 
     @property
     def ttft_ticks(self) -> float:
@@ -141,6 +147,12 @@ class ServeResult:
         independent of host/hardware speed. Each slot gets ticks/M
         visits, so this is tokens_out / ticks."""
         return self.tokens_out / self.ticks if self.ticks else 0.0
+
+    @property
+    def n_failed(self) -> int:
+        """Requests the scheduler retired with ``status="failed"``
+        (over-budget prompts, poisoned admissions) instead of serving."""
+        return sum(1 for c in self.completions if c.status == "failed")
 
 
 class ServingProgram:
@@ -431,13 +443,23 @@ class ServingEngine:
     admitting queued requests between blocks. ``report`` (optional
     :class:`...utils.telemetry.RunReport`) receives one event per
     admission/completion for the crash-safe JSONL stream.
+
+    The scheduler loop is exception-safe per request: ``submit`` raises
+    on an invalid request (the direct-API contract), but ``run`` retires
+    an invalid or poisoned request with a ``status="failed"``
+    :class:`Completion` plus a ``serve_failed`` report event and keeps
+    serving — one bad request must not wedge the live slots.
+    ``fault_plan`` (``...utils.resilience.FaultPlan``) injects
+    deterministic admission faults (``serve_poison_rids``) and per-rid
+    arrival delays (``serve_delay``) for the resilience tests.
     """
 
     def __init__(self, program: ServingProgram, params, *,
-                 report=None) -> None:
+                 report=None, fault_plan=None) -> None:
         self.program = program
         self.weights = program.prepare(params)
         self.report = report
+        self.fault_plan = fault_plan
         self.reset()
 
     def reset(self) -> None:
@@ -507,6 +529,28 @@ class ServingEngine:
                               tick=self._tick, prompt_len=plen,
                               budget=req.max_new_tokens)
 
+    def _scrub_slot(self, slot: int) -> None:
+        # a failed admission may have left partial mirror writes: park the
+        # slot dead (live=False masks every other field) and drop any
+        # scheduler bookkeeping so the slot goes straight back to free
+        h = self.host
+        h["live"][slot] = False
+        h["finished"][slot] = False
+        self._dirty.update(("live", "finished"))
+        self._slot_req.pop(slot, None)
+        self._slot_admit.pop(slot, None)
+
+    def _fail_request(self, req: Request, reason: str) -> None:
+        """Retire ``req`` unserved with a ``failed`` completion + event."""
+        self.completions.append(Completion(
+            rid=req.rid, prompt=list(map(int, req.prompt)), tokens=[],
+            slot=-1, admit_tick=-1, first_token_tick=-1, finish_tick=-1,
+            arrival=req.arrival, status="failed", reason=reason))
+        if self.report is not None:
+            self.report.event("serve_failed", rid=req.rid, tick=self._tick,
+                              reason=reason)
+            self.report.count("serve_failed")
+
     def _harvest(self) -> None:
         host = self.host
         for slot, req in list(self._slot_req.items()):
@@ -540,8 +584,20 @@ class ServingEngine:
         if policy not in ("continuous", "static"):
             raise ValueError(f"unknown policy {policy!r} (continuous|static)")
         self.reset()
-        for r in sorted(requests, key=lambda r: r.arrival):
-            self.submit(r)
+        plan = self.fault_plan
+        delay = dict(getattr(plan, "serve_delay", None) or {})
+        poison = set(getattr(plan, "serve_poison_rids", ()) or ())
+        # injected stragglers shift arrival BEFORE the sort — the pending
+        # queue's pop loop relies on arrival order
+        retimed = [dataclasses.replace(r, arrival=r.arrival + delay[r.rid])
+                   if r.rid in delay else r for r in requests]
+        for r in sorted(retimed, key=lambda r: r.arrival):
+            try:
+                self.submit(r)
+            except ValueError as e:
+                # over-budget prompt etc.: a per-request outcome, not a
+                # scheduler crash — the live slots keep serving
+                self._fail_request(r, str(e))
         p = self.program
         free = list(range(p.n_slots))
         wall0 = time.perf_counter()
@@ -550,7 +606,23 @@ class ServingEngine:
                 self.waiting.append(self.pending.popleft())
             if policy == "continuous" or len(free) == p.n_slots:
                 while free and self.waiting:
-                    self._admit(free.pop(0), self.waiting.popleft())
+                    req = self.waiting.popleft()
+                    slot = free[0]
+                    try:
+                        if req.rid in poison:
+                            from ..utils.resilience import SimulatedFault
+                            raise SimulatedFault(
+                                f"injected admission fault for rid "
+                                f"{req.rid}")
+                        self._admit(slot, req)
+                    except Exception as e:  # noqa: BLE001 — quarantine,
+                        # retire the request, keep the slot free and the
+                        # ring serving (wedging all slots is the failure
+                        # mode this loop exists to prevent)
+                        self._scrub_slot(slot)
+                        self._fail_request(req, f"admission failed: {e}")
+                        continue
+                    free.pop(0)
             if not self._slot_req:
                 if not self.waiting and not self.pending:
                     break  # drained

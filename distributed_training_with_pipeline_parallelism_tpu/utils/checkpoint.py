@@ -8,13 +8,35 @@ which at minimum needs parameter loading.
 
 from __future__ import annotations
 
+import json
+import logging
 import os
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 Pytree = Any
 
+# A checkpoint dir is COMMITTED once this marker file exists inside it.
+# Orbax's own directory layout gives no cheap "is this save complete?"
+# predicate for a process that died mid-flush; the marker is written
+# atomically (tmp + rename) strictly AFTER the flush, so its presence
+# implies a readable checkpoint. utils.resilience builds the manager /
+# retention / fallback-restore protocol on these primitives.
+COMMIT_MARKER = "_COMMITTED.json"
+
 
 _SHARED = None
+
+# Partial-restore sentinel: newer orbax exports ``ocp.PLACEHOLDER``; the
+# 0.7.x line in some containers does not. Fall back to a private object
+# nothing matches, so full-template restores (every training/resume path)
+# work regardless of orbax version, and only the partial-restore helpers
+# depend on the real sentinel being available.
+_NO_PLACEHOLDER = object()
+
+
+def _placeholder():
+    import orbax.checkpoint as ocp
+    return getattr(ocp, "PLACEHOLDER", _NO_PLACEHOLDER)
 
 
 def _checkpointer():
@@ -27,18 +49,72 @@ def _checkpointer():
     return _SHARED
 
 
+def wait_for_async_saves() -> None:
+    """Block until every async save issued through this process's shared
+    checkpointer has landed on disk (no-op when none is in flight)."""
+    if _SHARED is not None:
+        _SHARED.wait_until_finished()
+
+
+def write_commit_marker(path: str, meta: Dict[str, Any]) -> None:
+    """Atomically place the commit marker inside checkpoint dir ``path``:
+    write to a tmp file, ``os.replace`` into place — a crash mid-write
+    leaves no (partial) marker, so commitment is all-or-nothing."""
+    tmp = os.path.join(path, COMMIT_MARKER + ".tmp")
+    with open(tmp, "w") as fh:
+        json.dump(meta, fh, indent=2, default=str)
+        fh.write("\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, os.path.join(path, COMMIT_MARKER))
+
+
+def read_commit_marker(path: str) -> Optional[Dict[str, Any]]:
+    """The commit-marker dict of checkpoint dir ``path``, or None when
+    absent or unreadable (an unreadable marker is treated as
+    uncommitted — restore must not trust it)."""
+    marker = os.path.join(path, COMMIT_MARKER)
+    try:
+        with open(marker) as fh:
+            out = json.load(fh)
+        return out if isinstance(out, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def is_committed(path: str) -> bool:
+    return read_commit_marker(path) is not None
+
+
 def save_checkpoint(path: str, state: Pytree, wait: bool = True) -> None:
     """Save a pytree (params, or {'params': ..., 'opt_state': ...}) to
-    ``path`` (created; must not already contain a checkpoint).
+    ``path``. The dir must not already hold a *committed* checkpoint
+    (refused — silent overwrite of good state is never right); an
+    existing **uncommitted** dir — the shell a run killed between flush
+    and commit leaves behind — is removed and the save retried, so a
+    resumed run can re-save the same step it died on.
 
     ``wait=False`` returns as soon as the on-device state is snapshotted and
     lets Orbax write to disk in the background — training continues while
     the previous checkpoint flushes (the next save/restore waits for it
     first). The training loop uses this for periodic mid-run saves and
-    ``wait=True`` for the final one."""
+    ``wait=True`` for the final one. Saving does NOT write the commit
+    marker — callers (``utils.resilience.CheckpointManager``) commit
+    once the flush has finished."""
     ckpt = _checkpointer()
     ckpt.wait_until_finished()  # serialize with any in-flight async save
-    ckpt.save(os.path.abspath(path), state)
+    apath = os.path.abspath(path)
+    if os.path.isdir(apath):
+        if is_committed(apath):
+            raise ValueError(
+                f"refusing to overwrite committed checkpoint {apath} — "
+                "remove it (or let retention GC) first")
+        import shutil
+        logging.getLogger(__name__).warning(
+            "save_checkpoint: %s exists without a commit marker (prior "
+            "save died mid-flush?); removing and re-saving", apath)
+        shutil.rmtree(apath)
+    ckpt.save(apath, state)
     if wait:
         ckpt.wait_until_finished()
 
@@ -55,6 +131,8 @@ def restore_checkpoint(path: str, template: Optional[Pytree] = None) -> Pytree:
 
         import orbax.checkpoint as ocp
 
+        PH = _placeholder()
+
         def as_struct(x):
             # carry mesh-aware shardings (e.g. ZeRO-1 moments) so restore
             # materializes directly into the sharded layout; everything else
@@ -64,7 +142,7 @@ def restore_checkpoint(path: str, template: Optional[Pytree] = None) -> Pytree:
             # under). ocp.PLACEHOLDER leaves pass through: orbax skips them
             # (partial restore — e.g. the export CLI leaving the optimizer
             # moments on disk).
-            if x is ocp.PLACEHOLDER:
+            if x is PH:
                 return x
             sh = getattr(x, "sharding", None)
             sh = sh if isinstance(sh, NamedSharding) else None
@@ -72,7 +150,7 @@ def restore_checkpoint(path: str, template: Optional[Pytree] = None) -> Pytree:
 
         structs = jax.tree.map(as_struct, template)
         leaves = jax.tree.leaves(structs)
-        partial = any(l is ocp.PLACEHOLDER for l in leaves)
+        partial = any(l is PH for l in leaves)
         had_none = any(getattr(s, "sharding", 1) is None for s in leaves)
 
         def _restore(tree):
@@ -82,7 +160,7 @@ def restore_checkpoint(path: str, template: Optional[Pytree] = None) -> Pytree:
                 # entirely (never read from disk). It ignores the item
                 # structs' shardings, so they travel via restore_args.
                 rargs = jax.tree.map(
-                    lambda s: ocp.RestoreArgs() if s is ocp.PLACEHOLDER
+                    lambda s: ocp.RestoreArgs() if s is PH
                     else ocp.ArrayRestoreArgs(sharding=s.sharding,
                                               global_shape=s.shape,
                                               dtype=s.dtype),
@@ -120,7 +198,7 @@ def restore_checkpoint(path: str, template: Optional[Pytree] = None) -> Pytree:
                 "device", e)
             dev0 = jax.sharding.SingleDeviceSharding(jax.devices()[0])
             pinned = jax.tree.map(
-                lambda s: s if s is ocp.PLACEHOLDER
+                lambda s: s if s is PH
                 or getattr(s, "sharding", 1) is not None
                 else jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=dev0),
                 structs)
@@ -139,7 +217,7 @@ def restore_subtree(path: str, key: str, template: Pytree) -> Pytree:
 
     with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as c:
         md = c.metadata(os.path.abspath(path)).item_metadata.tree
-    full = jax.tree.map(lambda _: ocp.PLACEHOLDER, md)
+    full = jax.tree.map(lambda _: _placeholder(), md)
     if not isinstance(full, dict) or key not in full:
         raise KeyError(
             f"checkpoint at {path} has no {key!r} subtree "

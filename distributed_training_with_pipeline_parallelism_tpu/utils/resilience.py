@@ -1,0 +1,533 @@
+"""Resilience layer: crash-safe checkpoints, anomaly guards, preemption
+handling, and a deterministic fault-injection harness.
+
+The reference harness has no fault story at all (SURVEY.md §5: no
+checkpointing, every run is disposable), but at production scale failure
+is the common case — TPU preemptions, hosts killed mid-save, NaN
+blowups, stalled collectives. Production MPMD pipeline systems treat
+restartability and anomaly containment as first-class (cf. "Scaling
+Deep Learning Training with MPMD Pipeline Parallelism", PAPERS.md).
+This module supplies the pieces ``utils.train.fit`` wires together:
+
+- **Commit protocol** (:class:`CheckpointManager`,
+  :func:`latest_committed_step_dir`, :func:`gc_checkpoints`): a
+  checkpoint directory is not trustworthy just because it exists — an
+  async save that died mid-flush leaves a ``step_N`` shell Orbax cannot
+  restore. A save counts only once its ``_COMMITTED.json`` marker (step,
+  config fingerprint, pytree digest) has been atomically renamed into
+  place (``checkpoint.write_commit_marker``), and restore walks *past*
+  uncommitted or mismatched dirs to the newest committed one.
+  Keep-last-k retention garbage-collects older committed checkpoints
+  (and dead uncommitted shells strictly older than the newest committed
+  step — never a newer shell, which may be an in-flight async save).
+
+- **Anomaly guards** (:class:`AnomalyGuard`, :func:`init_guard_state`):
+  the train step folds a finite-check on loss and global grad norm into
+  the jitted program and *selects* the old params/opt-state when the
+  check fails — a skipped step, not a poisoned run. The guard state
+  (step / consecutive / total anomaly counters) stays device-resident
+  and is read back only at the existing log-sync points, so the happy
+  path costs zero extra host syncs. A bounded consecutive-anomaly
+  budget turns a persistent blowup into :class:`AnomalyBudgetExceeded`
+  after a final committed checkpoint.
+
+- **Preemption + stalls** (:class:`PreemptionHandler`,
+  :class:`StepWatchdog`): SIGTERM/SIGINT set a flag; ``fit`` finishes
+  the in-flight step, writes a synchronous committed checkpoint, emits a
+  ``preempted`` report event and returns — the resumed run continues
+  bit-exact. The watchdog is a daemon thread that fires a stall
+  callback when no step completes within a wall-clock timeout (stalled
+  collectives are otherwise silent forever).
+
+- **Fault injection** (:class:`FaultPlan`): deterministic faults —
+  NaN grads at chosen steps (baked into the traced program as a
+  step-index compare, so the injected run is reproducible), a raising
+  data iterator, a simulated kill between async flush and commit
+  (:class:`SimulatedKill`), a simulated preemption signal, poisoned /
+  delayed serving requests. Tests and ``scripts/resilience_smoke.py``
+  use it to prove interrupted + resumed runs bit-match uninterrupted
+  ones.
+
+JAX imports stay inside functions so importing this module (e.g. from
+the serving engine) stays cheap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import shutil
+import signal
+import threading
+import time
+from typing import (Any, Callable, Dict, Iterator, List, Mapping, Optional,
+                    Tuple)
+
+from .checkpoint import (is_committed, read_commit_marker, restore_checkpoint,
+                         save_checkpoint, wait_for_async_saves,
+                         write_commit_marker)
+
+Pytree = Any
+
+log = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Fault injection
+# ---------------------------------------------------------------------------
+
+
+class SimulatedFault(RuntimeError):
+    """Base class for every injected fault — tests catch this to tell an
+    injected failure from a real one."""
+
+
+class SimulatedKill(SimulatedFault):
+    """Raised by :meth:`CheckpointManager.save` after the checkpoint data
+    has been flushed but BEFORE the commit marker is written — the
+    moment a real host death leaves an uncommitted ``step_N`` shell."""
+
+
+class InjectedDataFault(SimulatedFault):
+    """Raised from inside the (wrapped) data iterator at a chosen batch
+    index — a host-side input-pipeline failure mid-run."""
+
+
+class AnomalyBudgetExceeded(RuntimeError):
+    """The guarded train loop saw more consecutive anomalous (non-finite)
+    steps than :attr:`AnomalyGuard.max_consecutive`. ``fit`` writes a
+    final committed checkpoint and an ``anomaly_abort`` report event
+    before raising this."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A deterministic script of faults to inject into one run.
+
+    All fields are step/request indices, so two runs with the same plan
+    fail identically — the property the resume-equivalence tests and
+    ``scripts/resilience_smoke.py`` are built on.
+
+    - ``nan_grad_steps``: poison the gradients (and loss) with NaN at
+      these global step indices. Baked into the traced train step as a
+      step-index compare; requires an :class:`AnomalyGuard` (otherwise
+      the poisoned update would corrupt the params forever).
+    - ``data_fail_step``: the wrapped data iterator raises
+      :class:`InjectedDataFault` instead of yielding this batch index
+      (counted over the iterator's lifetime, resume drain included).
+    - ``kill_in_save_step``: :meth:`CheckpointManager.save` of this step
+      flushes the checkpoint fully, then raises :class:`SimulatedKill`
+      without writing the commit marker.
+    - ``preempt_at_step``: ``fit`` triggers its own preemption handler
+      at the top of this step — the deterministic stand-in for a real
+      SIGTERM.
+    - ``serve_poison_rids``: the serving scheduler raises
+      :class:`SimulatedFault` while admitting these request ids; the
+      hardened loop must retire them as ``failed`` without wedging the
+      slot.
+    - ``serve_delay``: per-rid extra arrival delay in ticks — a slow /
+      straggling request injected deterministically.
+    """
+    nan_grad_steps: Tuple[int, ...] = ()
+    data_fail_step: Optional[int] = None
+    kill_in_save_step: Optional[int] = None
+    preempt_at_step: Optional[int] = None
+    serve_poison_rids: Tuple[int, ...] = ()
+    serve_delay: Optional[Mapping[int, float]] = None
+
+    def wrap_data(self, data: Iterator) -> Iterator:
+        """Wrap a data iterator so batch ``data_fail_step`` raises
+        :class:`InjectedDataFault` instead of being yielded. Identity
+        when no data fault is scheduled."""
+        if self.data_fail_step is None:
+            return data
+
+        def gen():
+            for i, batch in enumerate(data):
+                if i == self.data_fail_step:
+                    raise InjectedDataFault(
+                        f"injected data-iterator failure at batch {i}")
+                yield batch
+        return gen()
+
+
+# ---------------------------------------------------------------------------
+# Fingerprints / digests
+# ---------------------------------------------------------------------------
+
+
+def config_fingerprint(*objs: Any) -> str:
+    """Stable 16-hex-char fingerprint of run-defining configuration
+    (dataclasses, dicts, primitives). Stored in the commit marker so a
+    resume under a *different* config skips that checkpoint with a
+    warning instead of restoring garbage into the wrong program."""
+    def canon(x):
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            return {"__dc__": type(x).__name__,
+                    **{k: canon(v)
+                       for k, v in sorted(dataclasses.asdict(x).items())}}
+        if isinstance(x, Mapping):
+            return {str(k): canon(v) for k, v in sorted(x.items())}
+        if isinstance(x, (list, tuple)):
+            return [canon(v) for v in x]
+        return x
+    blob = json.dumps([canon(o) for o in objs], sort_keys=True,
+                      default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def pytree_digest(tree: Pytree) -> str:
+    """Structural digest of a pytree: treedef + per-leaf shape/dtype,
+    16 hex chars. Deliberately *not* a content hash — hashing leaf
+    values would force a device sync and a full host transfer on every
+    save. This catches the realistic corruption class (wrong template,
+    truncated/mixed-up state, changed optimizer) cheaply."""
+    import jax
+    leaves, treedef = jax.tree.flatten(tree)
+    h = hashlib.sha256(str(treedef).encode())
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", type(leaf).__name__)
+        h.update(f"{tuple(shape)}:{dtype};".encode())
+    return h.hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# Committed-checkpoint discovery + retention
+# ---------------------------------------------------------------------------
+
+
+def list_step_dirs(checkpoint_dir: str) -> List[Tuple[int, str]]:
+    """All ``step_{n}`` dirs under ``checkpoint_dir``, newest first."""
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    out = []
+    for name in os.listdir(checkpoint_dir):
+        if name.startswith("step_"):
+            try:
+                n = int(name[len("step_"):])
+            except ValueError:
+                continue
+            out.append((n, os.path.join(checkpoint_dir, name)))
+    return sorted(out, reverse=True)
+
+
+def latest_committed_step_dir(checkpoint_dir: str,
+                              fingerprint: Optional[str] = None,
+                              digest: Optional[str] = None,
+                              ) -> Optional[Tuple[int, str]]:
+    """Newest *committed* ``step_{n}`` under ``checkpoint_dir`` as
+    ``(n, path)``, or None.
+
+    Walks newest-to-oldest, skipping (with a warning) dirs with no
+    commit marker — a save that died mid-flush — and committed dirs
+    whose marker's config ``fingerprint`` / pytree ``digest`` disagree
+    with the expected ones (when given). Legacy escape hatch: a tree
+    where NO dir carries a marker predates the commit protocol; the
+    newest dir is returned with a warning rather than refusing to
+    resume old runs."""
+    dirs = list_step_dirs(checkpoint_dir)
+    if not dirs:
+        return None
+    any_marker = False
+    skipped: List[str] = []
+    for n, path in dirs:
+        marker = read_commit_marker(path)
+        if marker is None:
+            skipped.append(f"step_{n} (uncommitted)")
+            continue
+        any_marker = True
+        if (fingerprint and marker.get("fingerprint")
+                and marker["fingerprint"] != fingerprint):
+            skipped.append(f"step_{n} (config fingerprint "
+                           f"{marker['fingerprint']} != {fingerprint})")
+            continue
+        if digest and marker.get("digest") and marker["digest"] != digest:
+            skipped.append(f"step_{n} (pytree digest mismatch)")
+            continue
+        if skipped:
+            log.warning(
+                "checkpoint resume: skipping %s; falling back to committed "
+                "step_%d under %s", ", ".join(skipped), n, checkpoint_dir)
+        return n, path
+    if not any_marker:
+        n, path = dirs[0]
+        log.warning(
+            "checkpoint resume: no commit markers anywhere under %s "
+            "(legacy checkpoints predating the commit protocol); using "
+            "newest step_%d unverified", checkpoint_dir, n)
+        return n, path
+    log.warning("checkpoint resume: no usable committed checkpoint under "
+                "%s (skipped: %s)", checkpoint_dir, ", ".join(skipped))
+    return None
+
+
+def gc_checkpoints(checkpoint_dir: str, keep_last: int) -> List[str]:
+    """Retention GC: keep the newest ``keep_last`` *committed*
+    checkpoints, remove older committed ones and uncommitted shells
+    strictly older than the newest committed step. Uncommitted dirs at
+    or past the newest committed step are never touched — one of them
+    may be an in-flight async save. Returns the removed paths."""
+    if keep_last < 1:
+        raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+    dirs = list_step_dirs(checkpoint_dir)
+    committed = [(n, p) for n, p in dirs if is_committed(p)]
+    if not committed:
+        return []
+    keep = {p for _, p in committed[:keep_last]}
+    newest_committed = committed[0][0]
+    removed = []
+    for n, path in dirs:
+        if path in keep:
+            continue
+        if is_committed(path) or n < newest_committed:
+            shutil.rmtree(path, ignore_errors=True)
+            removed.append(path)
+    if removed:
+        log.info("checkpoint GC: removed %d of %d dirs under %s "
+                 "(keep_last=%d)", len(removed), len(dirs), checkpoint_dir,
+                 keep_last)
+    return removed
+
+
+class CheckpointManager:
+    """Crash-safe step-checkpoint lifecycle over one directory.
+
+    Wraps :func:`..checkpoint.save_checkpoint` /
+    :func:`..checkpoint.restore_checkpoint` with the commit protocol:
+    a synchronous save flushes, writes the commit marker, then GCs;
+    an async save (``wait=False``) records the marker as *pending* and
+    :meth:`commit_pending` (called automatically before the next save
+    or restore) waits for the flush and commits it. A process that
+    dies between flush and commit leaves an uncommitted shell that
+    restore skips and a later save at the same step overwrites.
+    """
+
+    def __init__(self, checkpoint_dir: str, *, keep_last: Optional[int] = None,
+                 fingerprint: Optional[str] = None,
+                 fault_plan: Optional[FaultPlan] = None) -> None:
+        self.checkpoint_dir = checkpoint_dir
+        self.keep_last = keep_last
+        self.fingerprint = fingerprint
+        self.fault_plan = fault_plan
+        self.gc_removed = 0
+        self.n_saved = 0
+        self.last_restored_path: Optional[str] = None
+        self._pending: Optional[Tuple[str, Dict[str, Any]]] = None
+        os.makedirs(checkpoint_dir, exist_ok=True)
+
+    def step_path(self, step: int) -> str:
+        return os.path.join(self.checkpoint_dir, f"step_{step}")
+
+    def save(self, step: int, state: Pytree, wait: bool = True) -> str:
+        """Save ``state`` as ``step_{step}``; commit immediately
+        (``wait=True``) or leave the commit pending behind the async
+        flush (``wait=False``). Idempotent: a step already committed
+        with the same state digest is left alone (the crash path may
+        re-save the last completed step)."""
+        self.commit_pending()
+        path = self.step_path(step)
+        meta = {"step": int(step),
+                "fingerprint": self.fingerprint,
+                "digest": pytree_digest(state),
+                "committed_unix": time.time()}
+        prior = read_commit_marker(path)
+        if (prior is not None and prior.get("step") == int(step)
+                and prior.get("digest") == meta["digest"]):
+            return path
+        kill = (self.fault_plan is not None
+                and self.fault_plan.kill_in_save_step == step)
+        # an injected kill must leave a fully-flushed-but-uncommitted
+        # shell, so force the flush to finish before "dying"
+        save_checkpoint(path, state, wait=wait or kill)
+        if kill:
+            raise SimulatedKill(
+                f"injected kill after flushing step_{step} (no commit "
+                "marker written)")
+        if wait:
+            write_commit_marker(path, meta)
+            self.n_saved += 1
+            self._gc()
+        else:
+            self._pending = (path, meta)
+        return path
+
+    def commit_pending(self) -> None:
+        """Land any outstanding async save: wait for the flush, write
+        its commit marker, run retention GC."""
+        if self._pending is None:
+            return
+        path, meta = self._pending
+        self._pending = None
+        wait_for_async_saves()
+        write_commit_marker(path, meta)
+        self.n_saved += 1
+        self._gc()
+
+    def restore_latest(self, template: Pytree,
+                       ) -> Optional[Tuple[int, str, Pytree]]:
+        """Restore the newest committed checkpoint matching this
+        manager's fingerprint and the template's structural digest.
+        Returns ``(step, path, state)`` or None."""
+        self.commit_pending()
+        latest = latest_committed_step_dir(
+            self.checkpoint_dir, fingerprint=self.fingerprint,
+            digest=pytree_digest(template))
+        if latest is None:
+            return None
+        n, path = latest
+        self.last_restored_path = path
+        return n, path, restore_checkpoint(path, template=template)
+
+    def _gc(self) -> None:
+        if self.keep_last:
+            self.gc_removed += len(
+                gc_checkpoints(self.checkpoint_dir, self.keep_last))
+
+    def stats(self) -> Dict[str, Any]:
+        """Summary block for the RunReport ``resilience`` section."""
+        committed = [n for n, p in list_step_dirs(self.checkpoint_dir)
+                     if is_committed(p)]
+        return {"n_committed": len(committed),
+                "last_committed_step": committed[0] if committed else None,
+                "n_saved": self.n_saved,
+                "gc_removed": self.gc_removed}
+
+
+# ---------------------------------------------------------------------------
+# Anomaly guard (device-side state; the jitted check lives in train.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AnomalyGuard:
+    """Policy for the jitted finite-check in the train step.
+
+    ``max_consecutive`` bounds how many anomalous (non-finite loss or
+    grad-norm) steps in a row are *skipped* before the loop gives up,
+    checkpoints, and raises :class:`AnomalyBudgetExceeded`. The budget
+    is enforced at log-sync granularity (the guard counters ride the
+    existing ``float(loss)`` sync — see docs/resilience.md), so with
+    ``log_every > 1`` the abort fires at the first log point at or
+    after the budget was crossed."""
+    max_consecutive: int = 3
+
+
+def init_guard_state(start_step: int = 0) -> Dict[str, Any]:
+    """Device-resident guard counters threaded through the guarded train
+    step: current global step, consecutive / total anomaly counts, and
+    the last anomalous step (-1 = none)."""
+    import jax.numpy as jnp
+    i32 = jnp.int32
+    return {"step": jnp.asarray(start_step, i32),
+            "consec": jnp.zeros((), i32),
+            "total": jnp.zeros((), i32),
+            "last_anomaly_step": jnp.asarray(-1, i32)}
+
+
+# ---------------------------------------------------------------------------
+# Preemption + stall watchdog
+# ---------------------------------------------------------------------------
+
+
+class PreemptionHandler:
+    """Context manager turning SIGTERM/SIGINT into a cooperative flag.
+
+    The signal handler only records the signal — the training loop
+    checks :attr:`triggered` after each completed step, so the in-flight
+    step always finishes and the checkpoint it writes is a real step
+    boundary. :meth:`trigger` injects the same flag programmatically
+    (used by :class:`FaultPlan.preempt_at_step`). Handlers are restored
+    on exit; installation is skipped with a debug log when not on the
+    main thread (Python forbids it there)."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT),
+                 enabled: bool = True) -> None:
+        self.signals = tuple(signals)
+        self.enabled = enabled
+        self.signum: Optional[int] = None
+        self._triggered = False
+        self._old: Dict[int, Any] = {}
+
+    @property
+    def triggered(self) -> bool:
+        return self._triggered
+
+    def trigger(self, signum: int = signal.SIGTERM) -> None:
+        self._on(signum, None)
+
+    def _on(self, signum, _frame) -> None:
+        self.signum = signum
+        self._triggered = True
+
+    def __enter__(self) -> "PreemptionHandler":
+        if self.enabled:
+            for s in self.signals:
+                try:
+                    self._old[s] = signal.signal(s, self._on)
+                except ValueError:  # not the main thread
+                    log.debug("preemption handler: cannot install signal "
+                              "%s off the main thread", s)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for s, old in self._old.items():
+            signal.signal(s, old)
+        self._old.clear()
+        return None
+
+
+class StepWatchdog:
+    """Wall-clock stall detector: a daemon thread that calls
+    ``on_stall({"step", "stalled_s"})`` once per stall when no
+    :meth:`beat` arrives within ``timeout_s``. Re-arms after the next
+    beat; never interrupts the run (a stalled collective is diagnosed,
+    not killed — aborting is the scheduler's call)."""
+
+    def __init__(self, timeout_s: float,
+                 on_stall: Callable[[Dict[str, Any]], None],
+                 poll_s: Optional[float] = None) -> None:
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self.timeout_s = timeout_s
+        self.on_stall = on_stall
+        self.stalls = 0
+        self._last_beat = time.monotonic()
+        self._last_step: Optional[int] = None
+        self._fired = False
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        poll = poll_s if poll_s is not None else max(timeout_s / 4.0, 0.01)
+        self._thread = threading.Thread(
+            target=self._watch, args=(poll,), name="dtpp-step-watchdog",
+            daemon=True)
+        self._thread.start()
+
+    def beat(self, step: int) -> None:
+        with self._lock:
+            self._last_beat = time.monotonic()
+            self._last_step = step
+            self._fired = False
+
+    def _watch(self, poll: float) -> None:
+        while not self._stop.wait(poll):
+            with self._lock:
+                stalled = time.monotonic() - self._last_beat
+                fire = stalled >= self.timeout_s and not self._fired
+                step = self._last_step
+                if fire:
+                    self._fired = True
+                    self.stalls += 1
+            if fire:
+                try:
+                    self.on_stall({"step": step,
+                                   "stalled_s": round(stalled, 3)})
+                except Exception:  # a broken callback must not kill the dog
+                    log.exception("step watchdog: on_stall callback raised")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)

@@ -43,6 +43,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -286,13 +287,18 @@ def serving_summary(result) -> Dict[str, Any]:
     factor alongside, so wall-clock latencies are one multiply away and
     the tick numbers stay comparable across hosts.
     """
-    ttfts = [c.ttft_ticks for c in result.completions]
-    tpots = [c.tpot_ticks for c in result.completions
-             if c.tpot_ticks is not None]
+    # failed completions (serving hardening: rejected/poisoned requests
+    # retired with status="failed") carry no latency stamps — count them
+    # separately, keep the percentile samples clean
+    ok = [c for c in result.completions
+          if getattr(c, "status", "ok") == "ok"]
+    ttfts = [c.ttft_ticks for c in ok]
+    tpots = [c.tpot_ticks for c in ok if c.tpot_ticks is not None]
     occ = [int(n) for _, n in result.occupancy]
     return {
         "policy": result.policy,
-        "n_requests": len(result.completions),
+        "n_requests": len(ok),
+        "n_failed": len(result.completions) - len(ok),
         "n_slots": int(result.n_slots),
         "ticks": int(result.ticks),
         "wall_s": float(result.wall_s),
@@ -339,8 +345,12 @@ class RunReport:
         self.events: List[Dict[str, Any]] = []
         self.telemetry: Optional[Dict[str, Any]] = None
         self.serving: List[Dict[str, Any]] = []
+        self.resilience: Optional[Dict[str, Any]] = None
         self.out_dir = out_dir
         self._events_fh = None
+        # the event stream is written from the training loop AND from
+        # background threads (resilience.StepWatchdog stall diagnostics)
+        self._events_lock = threading.Lock()
         if out_dir is not None:
             os.makedirs(out_dir, exist_ok=True)
 
@@ -371,13 +381,15 @@ class RunReport:
         """Append one timestamped event; streamed to ``events.jsonl`` when
         the report has an output directory."""
         row = {"t": time.time(), "kind": kind, **fields}
-        self.events.append(row)
-        if self.out_dir is not None:
-            if self._events_fh is None:
-                self._events_fh = open(
-                    os.path.join(self.out_dir, "events.jsonl"), "a")
-            self._events_fh.write(json.dumps(row, default=_jsonable) + "\n")
-            self._events_fh.flush()
+        with self._events_lock:  # watchdog threads stream events too
+            self.events.append(row)
+            if self.out_dir is not None:
+                if self._events_fh is None:
+                    self._events_fh = open(
+                        os.path.join(self.out_dir, "events.jsonl"), "a")
+                self._events_fh.write(json.dumps(row, default=_jsonable)
+                                      + "\n")
+                self._events_fh.flush()
 
     def attach_telemetry(self, telemetry: PipelineTelemetry) -> None:
         """Embed a measured-timeline section (:meth:`PipelineTelemetry.report`)."""
@@ -389,6 +401,13 @@ class RunReport:
         a benchmark that runs continuous and static policies back to
         back attaches both."""
         self.serving.append(summary)
+
+    def attach_resilience(self, section: Dict[str, Any]) -> None:
+        """Embed the run's resilience summary (anomaly / preemption /
+        stall counters, checkpoint-commit stats — assembled by
+        ``utils.train.fit`` from ``resilience.CheckpointManager.stats``
+        and the guard counters) as the manifest's ``resilience`` block."""
+        self.resilience = dict(section)
 
     # -- output ---------------------------------------------------------
 
@@ -409,6 +428,8 @@ class RunReport:
             out["telemetry"] = _jsonable(self.telemetry)
         if self.serving:
             out["serving"] = _jsonable(self.serving)
+        if self.resilience is not None:
+            out["resilience"] = _jsonable(self.resilience)
         return out
 
     def write(self, path: Optional[str] = None) -> Dict[str, Any]:
@@ -520,3 +541,15 @@ def validate_report(manifest: Dict[str, Any]) -> None:
                 if not isinstance(row.get(key), dict):
                     fail(f"serving summary needs a dict {key!r} "
                          "(p50/p95/p99/mean)")
+            if "n_failed" in row and not isinstance(row["n_failed"], int):
+                fail("serving summary n_failed must be an int")
+    res = manifest.get("resilience")
+    if res is not None:
+        if not isinstance(res, dict):
+            fail("resilience must be a dict")
+        for key in ("anomalies", "anomaly_budget", "stalls", "n_committed",
+                    "n_saved", "gc_removed"):
+            if key in res and not isinstance(res[key], int):
+                fail(f"resilience.{key} must be an int")
+        if "preempted" in res and not isinstance(res["preempted"], bool):
+            fail("resilience.preempted must be a bool")

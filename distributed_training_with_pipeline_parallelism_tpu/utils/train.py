@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import functools
 import json
+import logging
 import math
 import os
 import time
@@ -35,6 +37,7 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     tp_vocab_parallel: bool = False,
                     fsdp: bool = False, remat_backward=None,
                     unroll_ticks=None, telemetry=None,
+                    guard=None, fault_plan=None,
                     ) -> Callable[[Pytree, Any, jax.Array, jax.Array],
                                   Tuple[Pytree, Any, jax.Array]]:
     """Jitted ``(params, opt_state, tokens, targets) ->
@@ -54,33 +57,103 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     :func:`..parallel.pipeline.make_pipeline_grad_fn`). ``telemetry``
     (opt-in ``utils.telemetry.PipelineTelemetry``) records a measured
     tick/phase timeline for the grad program; None (default) compiles
-    zero instrumentation."""
+    zero instrumentation.
+
+    ``guard`` (a ``utils.resilience.AnomalyGuard``) switches to the
+    *guarded* step: ``(params, opt_state, tokens, targets[, rng],
+    guard_state) -> (params, opt_state, loss, guard_state)``. Inside the
+    same XLA program it checks loss and global grad norm for finiteness
+    and, on failure, SELECTS the incoming params/opt_state (the
+    anomalous step is skipped, the optimizer clock does not advance) and
+    bumps device-resident anomaly counters (``resilience.
+    init_guard_state``). Everything stays on device — the counters ride
+    the loss fetch at the caller's existing sync points, so the happy
+    path costs zero extra host syncs. ``fault_plan.nan_grad_steps``
+    (requires ``guard``) poisons the gradients at those global step
+    indices with NaN, baked into the traced program as a step-index
+    compare — the deterministic blowup the guard tests recover from."""
     grad_fn = make_pipeline_grad_fn(cfg, mesh, sched, moe=moe,
                                     sp_attn_impl=sp_attn_impl,
                                     tp_vocab_parallel=tp_vocab_parallel,
                                     fsdp=fsdp, remat_backward=remat_backward,
                                     unroll_ticks=unroll_ticks,
                                     telemetry=telemetry)
+    nan_steps = tuple(getattr(fault_plan, "nan_grad_steps", ()) or ())
+    if nan_steps and guard is None:
+        raise ValueError(
+            "fault_plan.nan_grad_steps requires an AnomalyGuard — injected "
+            "NaN grads without the guard would corrupt the params forever")
 
-    if cfg.dropout > 0.0:
-        # train-mode dropout: the step takes a per-step PRNG key
+    if guard is None:
+        if cfg.dropout > 0.0:
+            # train-mode dropout: the step takes a per-step PRNG key
+            @jax.jit
+            def train_step_dropout(params, opt_state, tokens, targets, rng):
+                loss, grads = grad_fn(params, tokens, targets, rng)
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, loss
+
+            return train_step_dropout
+
         @jax.jit
-        def train_step_dropout(params, opt_state, tokens, targets, rng):
-            loss, grads = grad_fn(params, tokens, targets, rng)
+        def train_step(params, opt_state, tokens, targets):
+            loss, grads = grad_fn(params, tokens, targets)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
 
-        return train_step_dropout
+        return train_step
+
+    def guarded(params, opt_state, tokens, targets, guard_state, rng=None):
+        if rng is None:
+            loss, grads = grad_fn(params, tokens, targets)
+        else:
+            loss, grads = grad_fn(params, tokens, targets, rng)
+        step = guard_state["step"]
+        if nan_steps:
+            bad = functools.reduce(
+                jnp.logical_or, [step == k for k in nan_steps])
+            poison = jnp.where(bad, jnp.float32(jnp.nan), jnp.float32(1.0))
+            grads = jax.tree.map(lambda g: g * poison.astype(g.dtype), grads)
+            loss = loss * poison.astype(loss.dtype)
+        # one fused predicate: loss AND global grad norm finite. Computed
+        # on device; no host readback here (the caller fetches the guard
+        # counters only where it already fetches the loss).
+        ok = jnp.isfinite(loss) & jnp.isfinite(optax.global_norm(grads))
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+
+        def keep(new, old):
+            return jnp.where(ok, new, old)
+
+        params = jax.tree.map(keep, new_params, params)
+        opt_state = jax.tree.map(keep, new_opt, opt_state)
+        anom = (~ok).astype(jnp.int32)
+        guard_state = {
+            "step": step + 1,
+            "consec": jnp.where(ok, 0, guard_state["consec"] + 1),
+            "total": guard_state["total"] + anom,
+            "last_anomaly_step": jnp.where(
+                ok, guard_state["last_anomaly_step"], step),
+        }
+        return params, opt_state, loss, guard_state
+
+    if cfg.dropout > 0.0:
+        @jax.jit
+        def guarded_step_dropout(params, opt_state, tokens, targets, rng,
+                                 guard_state):
+            return guarded(params, opt_state, tokens, targets, guard_state,
+                           rng)
+
+        return guarded_step_dropout
 
     @jax.jit
-    def train_step(params, opt_state, tokens, targets):
-        loss, grads = grad_fn(params, tokens, targets)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+    def guarded_step(params, opt_state, tokens, targets, guard_state):
+        return guarded(params, opt_state, tokens, targets, guard_state)
 
-    return train_step
+    return guarded_step
 
 
 def init_sharded_opt_state(optimizer: optax.GradientTransformation,
@@ -200,19 +273,14 @@ def evaluate(eval_fn, params, data: Iterator[Tuple[jax.Array, jax.Array]],
 
 
 def _latest_step_dir(checkpoint_dir: str) -> Optional[Tuple[int, str]]:
-    """Find the newest ``step_{n}`` checkpoint under ``checkpoint_dir``."""
-    if not os.path.isdir(checkpoint_dir):
-        return None
-    best = None
-    for name in os.listdir(checkpoint_dir):
-        if name.startswith("step_"):
-            try:
-                n = int(name[len("step_"):])
-            except ValueError:
-                continue
-            if best is None or n > best[0]:
-                best = (n, os.path.join(checkpoint_dir, name))
-    return best
+    """Find the newest *committed* ``step_{n}`` checkpoint under
+    ``checkpoint_dir``. Picking the newest dir by number alone would
+    hand resume a partially-written async save that died mid-flush;
+    :func:`.resilience.latest_committed_step_dir` skips uncommitted
+    shells (warning on fallback) and only trusts a marker-less tree
+    when NO dir has a marker (legacy checkpoints)."""
+    from .resilience import latest_committed_step_dir
+    return latest_committed_step_dir(checkpoint_dir)
 
 
 def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
@@ -232,7 +300,11 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         profile_steps: Tuple[int, int] = (2, 5),
         grad_accum: int = 1,
         report_dir: Optional[str] = None,
-        telemetry=None):
+        telemetry=None,
+        keep_last: Optional[int] = None,
+        guard=None, fault_plan=None,
+        handle_preemption: bool = False,
+        stall_timeout_s: Optional[float] = None):
     """Training loop over a ``(tokens, targets)`` iterator.
 
     Returns (params, list of (step, loss)). The data contract matches the
@@ -277,7 +349,37 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
     - ``telemetry``: opt-in ``telemetry.PipelineTelemetry`` wired into the
       compiled step (measured tick/phase timeline); its analysis is
       embedded in the report manifest when ``report_dir`` is also set.
+
+    Resilience (docs/resilience.md; all opt-in, off by default):
+
+    - Checkpoints go through ``resilience.CheckpointManager``: every save
+      is committed via an atomic marker (step, config fingerprint, pytree
+      digest) once its flush lands, resume restores the newest *committed*
+      checkpoint (skipping shells a killed async save left behind), and
+      ``keep_last`` garbage-collects older committed ones.
+    - ``guard`` (``True`` or a ``resilience.AnomalyGuard``): the jitted
+      step skips non-finite steps (see :func:`make_train_step`); the
+      device-resident counters are read only at log points (zero extra
+      syncs per step), anomalies land as report events/counters, and
+      exceeding the consecutive-anomaly budget checkpoints the last good
+      state and raises ``resilience.AnomalyBudgetExceeded``.
+    - ``handle_preemption``: SIGTERM/SIGINT finish the in-flight step,
+      write a synchronous committed checkpoint, emit a ``preempted``
+      event and return normally — the resumed run continues bit-exact.
+    - ``stall_timeout_s``: a wall-clock watchdog thread logs (and
+      reports) a ``stall`` diagnostic when no step completes in time.
+    - Any other crash banks the last completed step in a committed
+      checkpoint before the exception propagates.
+    - ``fault_plan`` (``resilience.FaultPlan``) injects deterministic
+      faults — NaN grads, data-iterator failure, kill-during-save,
+      simulated preemption — for the resilience tests and smoke.
     """
+    from .resilience import (AnomalyBudgetExceeded, AnomalyGuard,
+                             CheckpointManager, PreemptionHandler,
+                             SimulatedKill, StepWatchdog,
+                             config_fingerprint, init_guard_state)
+    if guard is True:
+        guard = AnomalyGuard()
     if optimizer is None:
         # the LR schedule advances once per OPTIMIZER update, which under
         # grad_accum happens every k data batches — size its horizon in
@@ -290,7 +392,8 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                               tp_vocab_parallel=tp_vocab_parallel,
                               fsdp=fsdp, remat_backward=remat_backward,
                               unroll_ticks=unroll_ticks,
-                              telemetry=telemetry)
+                              telemetry=telemetry,
+                              guard=guard, fault_plan=fault_plan)
     report = None
     if report_dir is not None:
         from .telemetry import RunReport
@@ -317,14 +420,22 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
     else:
         opt_state = optimizer.init(params)
 
+    mgr = None
+    if checkpoint_dir:
+        mgr = CheckpointManager(
+            checkpoint_dir, keep_last=keep_last,
+            fingerprint=config_fingerprint(cfg, sched, dict(mesh.shape)),
+            fault_plan=fault_plan)
+    if fault_plan is not None:
+        data = fault_plan.wrap_data(data)
+
     start_step = 0
-    if resume and checkpoint_dir:
-        latest = _latest_step_dir(checkpoint_dir)
-        if latest is not None:
-            n, path = latest
-            state = restore_checkpoint(path, template={
-                "params": params, "opt_state": opt_state,
-                "step": jnp.asarray(0)})
+    if resume and mgr is not None:
+        restored = mgr.restore_latest({
+            "params": params, "opt_state": opt_state,
+            "step": jnp.asarray(0)})
+        if restored is not None:
+            n, path, state = restored
             # the restore template carries the live shardings (see
             # checkpoint.restore_checkpoint), so a zero1 run restores its
             # moments directly into the sharded layout
@@ -335,11 +446,15 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                     next(data)
             if verbose:
                 print(f"resumed from {path} (step {n})", flush=True)
+            if report is not None:
+                report.event("resumed", step=n, path=path)
 
     def _save(i, wait=True):
-        save_checkpoint(os.path.join(checkpoint_dir, f"step_{i}"),
-                        {"params": params, "opt_state": opt_state,
-                         "step": jnp.asarray(i)}, wait=wait)
+        mgr.save(i, {"params": params, "opt_state": opt_state,
+                     "step": jnp.asarray(i)}, wait=wait)
+
+    guard_state = init_guard_state(start_step) if guard is not None else None
+    guard_seen = 0  # anomalies already surfaced (host high-water mark)
 
     # Per-step dropout keys fold the step index from one base key, so a
     # resumed run draws the same masks the uninterrupted run would have.
@@ -364,81 +479,204 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
             report.event("eval", step=i, **m)
         return m
 
+    preempt = PreemptionHandler(enabled=handle_preemption)
+    watchdog = None
+    if stall_timeout_s:
+        def _on_stall(info):
+            logging.getLogger(__name__).warning(
+                "fit: no step completed in %.1fs (last completed step %s) "
+                "— stalled collective or dead input pipeline?",
+                info["stalled_s"], info["step"])
+            if report is not None:
+                report.count("stalls")
+                report.event("stall", **info)
+        watchdog = StepWatchdog(stall_timeout_s, _on_stall)
+
     history = []
     window_start = time.perf_counter()
     window_tokens = 0
     profiling = False
-    # profile_steps counts from the first step THIS run executes, so a
-    # resumed job still captures a window instead of silently skipping it
-    prof_start = start_step + profile_steps[0]
-    prof_stop = start_step + max(profile_steps[1], profile_steps[0] + 1)
-    for i in range(start_step, num_steps):
-        if profile_dir is not None:
-            if i == prof_start and not profiling:
-                jax.profiler.start_trace(profile_dir)
-                profiling = True
-            elif i == prof_stop and profiling:
-                jax.profiler.stop_trace()
-                profiling = False
-                if verbose:
-                    print(f"profile trace written to {profile_dir}", flush=True)
-        tokens, targets = next(data)
-        # first executed step = trace + compile + run; the report's
-        # compile_s timer brackets it (forced, so the timer is honest)
-        first = report is not None and i == start_step
-        with (report.timer("compile_s") if first
-              else contextlib.nullcontext()):
-            if drop_key is not None:
-                params, opt_state, loss = step_fn(
-                    params, opt_state, tokens, targets,
-                    jax.random.fold_in(drop_key, i))
-            else:
-                params, opt_state, loss = step_fn(params, opt_state,
-                                                  tokens, targets)
-            if first:
-                jax.block_until_ready(loss)
-        window_tokens += tokens.shape[0] * tokens.shape[1]
-        if i % log_every == 0 or i == num_steps - 1:
-            loss_f = float(loss)  # device sync: closes the timing window
-            elapsed = time.perf_counter() - window_start
-            history.append((i, loss_f))
-            if verbose:
-                print(f"step {i}: loss {loss_f:.4f}", flush=True)
-            if metrics_path:
-                with open(metrics_path, "a") as f:
-                    f.write(json.dumps({
-                        "step": i, "loss": loss_f,
-                        "tokens_per_sec": round(window_tokens / elapsed, 2),
-                        "elapsed_s": round(elapsed, 4)}) + "\n")
-            if report is not None:
-                report.event("train_log", step=i, loss=loss_f,
-                             tokens_per_sec=round(window_tokens / elapsed, 2),
-                             elapsed_s=round(elapsed, 4))
-            window_start = time.perf_counter()
-            window_tokens = 0
-        if (eval_fn is not None and (i + 1) % eval_every == 0
-                and i != num_steps - 1):
-            _eval(i)
-            # eval time isn't train time: restart the whole timing window
-            # (tokens too, else the next tokens_per_sec over-reports)
-            window_start = time.perf_counter()
-            window_tokens = 0
-        if (checkpoint_dir and checkpoint_every
-                and (i + 1) % checkpoint_every == 0 and i != num_steps - 1):
-            _save(i, wait=False)  # flush in the background; training continues
-    if profiling:  # profile window ran past the last step
-        jax.profiler.stop_trace()
-    if eval_fn is not None and num_steps > start_step:
-        _eval(num_steps - 1)
-    if checkpoint_dir and checkpoint_every and num_steps > start_step:
-        _save(num_steps - 1)
-    if report is not None:
-        report.count("steps", max(num_steps - start_step, 0))
+    preempted = False
+    last_done = start_step - 1  # newest step whose outputs params hold
+
+    def _finalize_report():
+        if report is None:
+            return
+        report.count("steps", max(last_done - start_step + 1, 0))
         if history:
             report.gauge("final_loss", history[-1][1])
         if telemetry is not None:
             report.attach_telemetry(telemetry)
+        res = {}
+        if mgr is not None:
+            res.update(mgr.stats())
+        if guard is not None:
+            res["anomaly_budget"] = guard.max_consecutive
+            res["anomalies"] = guard_seen
+        if handle_preemption or (fault_plan is not None
+                                 and fault_plan.preempt_at_step is not None):
+            res["preempted"] = preempted
+        if watchdog is not None:
+            res["stalls"] = watchdog.stalls
+        if res:
+            report.attach_resilience(res)
         report.write()
+
+    # profile_steps counts from the first step THIS run executes, so a
+    # resumed job still captures a window instead of silently skipping it
+    prof_start = start_step + profile_steps[0]
+    prof_stop = start_step + max(profile_steps[1], profile_steps[0] + 1)
+    try:
+        with preempt:
+            for i in range(start_step, num_steps):
+                if fault_plan is not None and fault_plan.preempt_at_step == i:
+                    preempt.trigger()  # deterministic stand-in for SIGTERM
+                if profile_dir is not None:
+                    if i == prof_start and not profiling:
+                        jax.profiler.start_trace(profile_dir)
+                        profiling = True
+                    elif i == prof_stop and profiling:
+                        jax.profiler.stop_trace()
+                        profiling = False
+                        if verbose:
+                            print(f"profile trace written to {profile_dir}",
+                                  flush=True)
+                tokens, targets = next(data)
+                # first executed step = trace + compile + run; the report's
+                # compile_s timer brackets it (forced, so the timer is honest)
+                first = report is not None and i == start_step
+                with (report.timer("compile_s") if first
+                      else contextlib.nullcontext()):
+                    args = (params, opt_state, tokens, targets)
+                    if drop_key is not None:
+                        args += (jax.random.fold_in(drop_key, i),)
+                    if guard_state is not None:
+                        params, opt_state, loss, guard_state = step_fn(
+                            *args, guard_state)
+                    else:
+                        params, opt_state, loss = step_fn(*args)
+                    if first:
+                        jax.block_until_ready(loss)
+                last_done = i
+                if watchdog is not None:
+                    watchdog.beat(i)
+                window_tokens += tokens.shape[0] * tokens.shape[1]
+                if i % log_every == 0 or i == num_steps - 1:
+                    loss_f = float(loss)  # device sync: closes the timing window
+                    elapsed = time.perf_counter() - window_start
+                    history.append((i, loss_f))
+                    if verbose:
+                        print(f"step {i}: loss {loss_f:.4f}", flush=True)
+                    if metrics_path:
+                        with open(metrics_path, "a") as f:
+                            f.write(json.dumps({
+                                "step": i, "loss": loss_f,
+                                "tokens_per_sec": round(window_tokens / elapsed,
+                                                        2),
+                                "elapsed_s": round(elapsed, 4)}) + "\n")
+                    if report is not None:
+                        report.event("train_log", step=i, loss=loss_f,
+                                     tokens_per_sec=round(window_tokens / elapsed,
+                                                          2),
+                                     elapsed_s=round(elapsed, 4))
+                    if guard_state is not None:
+                        # the counters were computed by the same program as the
+                        # loss just fetched — this read rides that sync, it
+                        # does not add one
+                        gs = {k: int(v)
+                              for k, v in jax.device_get(guard_state).items()}
+                        if gs["total"] > guard_seen:
+                            delta = gs["total"] - guard_seen
+                            guard_seen = gs["total"]
+                            if verbose:
+                                print(f"step {i}: anomaly guard skipped {delta} "
+                                      f"step(s) (total {gs['total']}, last at "
+                                      f"step {gs['last_anomaly_step']})",
+                                      flush=True)
+                            if report is not None:
+                                report.count("anomalies", delta)
+                                report.event(
+                                    "anomaly", step=i, total=gs["total"],
+                                    consec=gs["consec"],
+                                    last_anomaly_step=gs["last_anomaly_step"])
+                        if gs["consec"] >= guard.max_consecutive:
+                            # params/opt_state are the last GOOD state — every
+                            # anomalous update was selected away in the step
+                            if report is not None:
+                                report.count("anomaly_aborts")
+                                report.event("anomaly_abort", step=i,
+                                             consec=gs["consec"],
+                                             budget=guard.max_consecutive)
+                            if mgr is not None:
+                                _save(i, wait=True)
+                            _finalize_report()
+                            raise AnomalyBudgetExceeded(
+                                f"{gs['consec']} consecutive anomalous steps at "
+                                f"step {i} (budget {guard.max_consecutive})"
+                                + (" — last good state checkpointed"
+                                   if mgr is not None else ""))
+                    window_start = time.perf_counter()
+                    window_tokens = 0
+                if (eval_fn is not None and (i + 1) % eval_every == 0
+                        and i != num_steps - 1):
+                    _eval(i)
+                    # eval time isn't train time: restart the whole timing
+                    # window (tokens too, else tokens_per_sec over-reports)
+                    window_start = time.perf_counter()
+                    window_tokens = 0
+                if preempt.triggered:
+                    # the in-flight step already finished (the handler only
+                    # sets a flag): bank it synchronously and exit resumable
+                    preempted = True
+                    sig = preempt.signum
+                    if verbose:
+                        print(f"step {i}: preemption ({sig}) — checkpointing "
+                              "and exiting resumable", flush=True)
+                    if report is not None:
+                        report.count("preemptions")
+                        report.event("preempted", step=i,
+                                     signal=int(sig) if sig is not None
+                                     else None)
+                    if mgr is not None:
+                        _save(i, wait=True)
+                    break
+                if (mgr is not None and checkpoint_every
+                        and (i + 1) % checkpoint_every == 0
+                        and i != num_steps - 1):
+                    _save(i, wait=False)  # flush in background; training goes on
+    except (SimulatedKill, AnomalyBudgetExceeded):
+        raise  # injected death / already-handled abort: no crash save
+    except BaseException as e:
+        # crash-safe exit: params/opt_state are step last_done's outputs —
+        # bank them committed so the run resumes instead of restarting
+        if mgr is not None and last_done >= start_step:
+            try:
+                _save(last_done, wait=True)
+                if verbose:
+                    print(f"crash at step {last_done + 1}: banked committed "
+                          f"checkpoint at step {last_done}", flush=True)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "fit: crash checkpoint at step %d failed", last_done)
+        if report is not None:
+            report.event("crash", step=last_done, error=repr(e))
+            with contextlib.suppress(Exception):
+                _finalize_report()
+        raise
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+        if profiling:  # the profile window ran past the last executed step
+            jax.profiler.stop_trace()
+            profiling = False
+    if eval_fn is not None and num_steps > start_step and not preempted:
+        _eval(num_steps - 1)
+    if (mgr is not None and checkpoint_every and num_steps > start_step
+            and not preempted):
+        _save(num_steps - 1)
+    if mgr is not None:
+        mgr.commit_pending()
+    _finalize_report()
     return params, history
 
 

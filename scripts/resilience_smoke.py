@@ -1,0 +1,161 @@
+"""Resilience smoke: kill a run mid-save, resume it, demand a bit-match.
+
+The tier-1 liveness check for the resilience layer (scripts/tier1.sh runs
+it before the suite; CI uploads the resulting report as an artifact):
+
+- run A: a clean 8-step training run with periodic async checkpoints and
+  ``keep_last`` retention — the ground truth;
+- run B: the same run with an injected kill during the step-5 checkpoint
+  flush (``FaultPlan.kill_in_save_step``) — dies with ``SimulatedKill``,
+  leaving an UNcommitted ``step_5`` shell behind;
+- run C: resume over B's checkpoint dir — must fall back past the shell
+  to the newest committed step and finish with params **bitwise equal**
+  to run A's (same deterministic data stream);
+- run D: anomaly guard + injected NaN grads at step 3 + simulated
+  preemption at step 6, with a ``RunReport`` — the skipped step and the
+  preemption must land in validated report counters.
+
+Writes run D's ``report.json`` (+ ``events.jsonl``) into the output
+directory (argv[1], default ``/tmp/resilience_smoke``) and exits 0 on
+success, 1 with a reason on any violation. A few tiny-model pipeline
+compiles: target a couple of minutes on a CI host.
+"""
+
+import os
+import sys
+
+# must precede the first jax import: 2 simulated devices, CPU backend
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=2 "
+                           + os.environ.get("XLA_FLAGS", ""))
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+STEPS = 8
+KILL_STEP = 5
+
+
+def main() -> int:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/resilience_smoke"
+
+    import json
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import distributed_training_with_pipeline_parallelism_tpu as dtpp
+    from distributed_training_with_pipeline_parallelism_tpu.models import (
+        transformer as tfm)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+        make_mesh)
+    from distributed_training_with_pipeline_parallelism_tpu.utils import train
+    from distributed_training_with_pipeline_parallelism_tpu.utils.resilience import (
+        FaultPlan, SimulatedKill, latest_committed_step_dir)
+    from distributed_training_with_pipeline_parallelism_tpu.utils.telemetry import (
+        validate_report)
+
+    cfg = dtpp.ModelConfig(dim=16, n_layers=2, n_heads=2, vocab_size=32,
+                           ffn_dim=32, max_seq_len=8)
+    mesh = make_mesh(n_pipe=2)
+    sched = dtpp.ScheduleConfig(name="1F1B", n_microbatches=4)
+
+    def run(ckpt, *, resume=False, fault_plan=None, guard=None,
+            report_dir=None, handle_preemption=False):
+        params = tfm.transformer_init(jax.random.key(0), cfg)
+        data = train.synthetic_data(cfg, 4, 8, seed=0)
+        return train.fit(cfg, mesh, sched, params, data, STEPS,
+                         log_every=1, verbose=False,
+                         checkpoint_dir=ckpt, checkpoint_every=2,
+                         keep_last=2, resume=resume, fault_plan=fault_plan,
+                         guard=guard, report_dir=report_dir,
+                         handle_preemption=handle_preemption)
+
+    work = tempfile.mkdtemp(prefix="resilience_smoke_")
+    try:
+        ckpt_a = os.path.join(work, "a")
+        params_a, hist_a = run(ckpt_a)
+
+        # retention GC held the committed population at keep_last
+        committed = [d for d in os.listdir(ckpt_a)
+                     if os.path.exists(os.path.join(ckpt_a, d,
+                                                    "_COMMITTED.json"))]
+        if len(committed) != 2:
+            print(f"resilience_smoke: keep_last=2 but {sorted(committed)} "
+                  "committed dirs survive", file=sys.stderr)
+            return 1
+
+        ckpt_b = os.path.join(work, "b")
+        try:
+            run(ckpt_b, fault_plan=FaultPlan(kill_in_save_step=KILL_STEP))
+        except SimulatedKill:
+            pass
+        else:
+            print("resilience_smoke: injected kill did not fire",
+                  file=sys.stderr)
+            return 1
+        shell = os.path.join(ckpt_b, f"step_{KILL_STEP}")
+        if os.path.exists(os.path.join(shell, "_COMMITTED.json")):
+            print("resilience_smoke: killed save left a COMMITTED marker",
+                  file=sys.stderr)
+            return 1
+        latest = latest_committed_step_dir(ckpt_b)
+        if latest is None or latest[0] >= KILL_STEP:
+            print(f"resilience_smoke: latest committed is {latest}, expected "
+                  f"a step before the kill at {KILL_STEP}", file=sys.stderr)
+            return 1
+
+        params_c, hist_c = run(ckpt_b, resume=True)
+        mismatch = [
+            jax.tree_util.keystr(path)
+            for (path, x), y in zip(
+                jax.tree_util.tree_leaves_with_path(params_a),
+                jax.tree.leaves(params_c))
+            if not np.array_equal(np.asarray(x), np.asarray(y))]
+        if mismatch:
+            print(f"resilience_smoke: resumed params diverge from the "
+                  f"uninterrupted run at {len(mismatch)} leaves "
+                  f"(e.g. {mismatch[0]})", file=sys.stderr)
+            return 1
+        tail_a = [(s, l) for s, l in hist_a if s > latest[0]]
+        if [s for s, _ in tail_a] != [s for s, _ in hist_c]:
+            print(f"resilience_smoke: resumed history steps {hist_c} do not "
+                  f"continue the clean run's tail {tail_a}", file=sys.stderr)
+            return 1
+
+        ckpt_d = os.path.join(work, "d")
+        run(ckpt_d, report_dir=out_dir,
+            fault_plan=FaultPlan(nan_grad_steps=(3,), preempt_at_step=6),
+            guard=True, handle_preemption=True)
+        with open(os.path.join(out_dir, "report.json")) as fh:
+            manifest = json.load(fh)
+        validate_report(manifest)
+        counters = manifest.get("counters", {})
+        res = manifest.get("resilience", {})
+        if counters.get("anomalies", 0) < 1 or res.get("anomalies", 0) < 1:
+            print(f"resilience_smoke: NaN step not counted as an anomaly "
+                  f"(counters={counters}, resilience={res})", file=sys.stderr)
+            return 1
+        if counters.get("preemptions") != 1 or res.get("preempted") is not True:
+            print(f"resilience_smoke: preemption not reported "
+                  f"(counters={counters}, resilience={res})", file=sys.stderr)
+            return 1
+        if latest_committed_step_dir(ckpt_d) is None:
+            print("resilience_smoke: preempted run left no committed "
+                  "checkpoint to resume from", file=sys.stderr)
+            return 1
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    print(f"resilience_smoke: OK — resumed run bit-matches the clean one "
+          f"past an injected kill at step {KILL_STEP}; anomaly + preemption "
+          f"counters validated, report at "
+          f"{os.path.join(out_dir, 'report.json')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,16 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
   exit 1
 fi
 echo "SERVE_SMOKE=ok"
+# Resilience liveness last (own budget): a run killed mid-checkpoint-flush
+# must resume from the last committed step and finish bitwise equal to the
+# uninterrupted run, with anomaly/preemption counters in a validated
+# report. Lands in /tmp/resilience_smoke for CI upload.
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python scripts/resilience_smoke.py /tmp/resilience_smoke; then
+  echo "RESILIENCE_SMOKE=fail"
+  exit 1
+fi
+echo "RESILIENCE_SMOKE=ok"
 rm -f /tmp/_t1.log
 timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \

@@ -94,6 +94,22 @@ def main():
                     help="save every N steps (default: final only)")
     ap.add_argument("--auto-resume", action="store_true",
                     help="resume from the newest checkpoint in --ckpt")
+    ap.add_argument("--keep-last", type=int, default=0,
+                    help="retention GC: keep only the newest K committed "
+                         "checkpoints in --ckpt (0 keeps everything)")
+    ap.add_argument("--anomaly-guard", action="store_true",
+                    help="jitted finite-check on loss/grad-norm each step; "
+                         "non-finite steps are skipped (params/opt state "
+                         "held) instead of poisoning the run")
+    ap.add_argument("--anomaly-budget", type=int, default=3,
+                    help="abort (after a final checkpoint) once this many "
+                         "CONSECUTIVE steps are non-finite")
+    ap.add_argument("--preemption-safe", action="store_true",
+                    help="catch SIGTERM/SIGINT, finish the in-flight step, "
+                         "write a sync checkpoint to --ckpt, exit resumable")
+    ap.add_argument("--stall-timeout", type=float, default=0.0,
+                    help="wall-clock watchdog: log stall diagnostics when no "
+                         "step completes for this many seconds (0 disables)")
     ap.add_argument("--metrics", default="",
                     help="append per-log-point JSON lines here")
     ap.add_argument("--profile", default="",
@@ -165,6 +181,11 @@ def main():
         ap.error("--vocab-parallel requires --tp > 1")
     if args.auto_resume and not args.ckpt:
         ap.error("--auto-resume requires --ckpt (the dir holding step_N/)")
+    if args.keep_last and not args.ckpt:
+        ap.error("--keep-last requires --ckpt")
+    if args.preemption_safe and not args.ckpt:
+        ap.error("--preemption-safe requires --ckpt (it must have somewhere "
+                 "to save the resumable state)")
 
     if args.simulate_devices:
         from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
@@ -180,6 +201,8 @@ def main():
     from distributed_training_with_pipeline_parallelism_tpu.utils import train
     from distributed_training_with_pipeline_parallelism_tpu.utils.checkpoint import (
         restore_checkpoint)
+    from distributed_training_with_pipeline_parallelism_tpu.utils.resilience import (
+        AnomalyGuard)
 
     def build_cfg(**overrides):
         if args.model.startswith("gpt2-"):
@@ -323,7 +346,12 @@ def main():
         dropout_seed=args.seed,
         eval_data=eval_data, eval_every=args.eval_every,
         eval_batches=args.eval_batches,
-        profile_dir=args.profile or None, grad_accum=args.grad_accum)
+        profile_dir=args.profile or None, grad_accum=args.grad_accum,
+        keep_last=args.keep_last or None,
+        guard=(AnomalyGuard(max_consecutive=args.anomaly_budget)
+               if args.anomaly_guard else None),
+        handle_preemption=args.preemption_safe,
+        stall_timeout_s=args.stall_timeout or None)
     if args.ckpt:
         print(f"checkpoints in {args.ckpt}", flush=True)
     if history:

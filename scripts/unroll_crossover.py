@@ -61,7 +61,7 @@ def _measure(step, batch, iters):
         force_completion)
     force_completion(loss)
     compile_s = time.perf_counter() - t0
-    elapsed, _ = _time_step(step, CFG_PARAMS, tokens, targets, iters)
+    elapsed, _, _ = _time_step(step, CFG_PARAMS, tokens, targets, iters)
     return {"tokens_per_sec": round(batch * SEQ * iters / elapsed, 1),
             "compile_s": round(compile_s, 2),
             "elapsed_s": round(elapsed, 3)}
