@@ -50,6 +50,12 @@ _LAZY = {
     "ServingEngine": ("serving", "ServingEngine"),
     "make_serving_step_fn": ("serving", "make_serving_step_fn"),
     "run_serve_bench": ("serving.bench", "run_serve_bench"),
+    # static analysis (docs/static_analysis.md)
+    "check_table": ("analysis", "check_table"),
+    "TableReport": ("analysis", "TableReport"),
+    "audit_fn": ("analysis", "audit_fn"),
+    "lint_repo": ("analysis", "lint_repo"),
+    "run_checks": ("analysis", "run_checks"),
 }
 
 

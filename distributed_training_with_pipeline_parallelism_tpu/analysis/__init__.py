@@ -1,0 +1,118 @@
+"""Static analysis passes over the pipeline framework (docs/static_analysis.md).
+
+Three passes plus a CLI (``python -m
+distributed_training_with_pipeline_parallelism_tpu.analysis``):
+
+- :mod:`.table_check` — symbolic interpreter over compiled tick tables:
+  RAW/WAR/WAW slot hazards with exact (device, tick, column) locations,
+  ppermute send/recv pairing per ring direction, route consistency,
+  compression roundtrips, unit counts, slot high-water marks (a static
+  activation-memory bound), and per-channel comm volume (the unrolled
+  executor's predicted ppermute count).
+- :mod:`.jaxpr_audit` — walks traced step functions: zero host callbacks
+  with telemetry off, collective counts/axes vs the mesh and the table
+  verifier's prediction, dtype drift.
+- :mod:`.repo_lint` — ast rules: no host calls in tick/scan bodies,
+  lazy-export discipline in ``__init__.py``, no bare ``jax.jit`` without
+  a named scope in ``parallel/``.
+
+The builders call the table passes at table-build time behind the
+``DTPP_VERIFY_TABLES`` env flag (on in tests, off by default in
+production runs — the checks are pure numpy but nonzero).
+"""
+
+import os
+
+VERIFIER_VERSION = 1
+
+
+def verify_tables_enabled() -> bool:
+    """True when ``DTPP_VERIFY_TABLES`` asks for build-time verification."""
+    return os.environ.get("DTPP_VERIFY_TABLES", "").lower() not in (
+        "", "0", "false", "off", "no")
+
+
+def maybe_verify_schedule(cs) -> None:
+    """Build-time hook (``parallel.pipeline._compile``): verify a compiled
+    schedule's table when ``DTPP_VERIFY_TABLES`` is set; raise
+    ``ScheduleError`` naming every hazard location otherwise stay silent."""
+    if not verify_tables_enabled():
+        return
+    from ..parallel.schedules import ScheduleError
+    from .table_check import check_table
+    report = check_table(cs)
+    if not report.ok:
+        raise ScheduleError(
+            f"static table verification failed for {cs.name} "
+            f"(D={cs.n_devices}, V={cs.n_virtual}, M={cs.n_microbatches}, "
+            f"{cs.placement}): "
+            + "; ".join(str(h) for h in report.hazards[:8]))
+
+
+def maybe_verify_forward_table(table, n_devices: int, n_virtual: int,
+                               n_microbatches: int, n_slots: int) -> None:
+    """Build-time hook for the forward-only executors
+    (``pipeline._fwd_tick_table``)."""
+    if not verify_tables_enabled():
+        return
+    from ..parallel.schedules import ScheduleError
+    from .table_check import check_forward_table
+    report = check_forward_table(table, n_devices, n_virtual,
+                                 n_microbatches, n_slots)
+    if not report.ok:
+        raise ScheduleError(
+            f"static forward-table verification failed "
+            f"(D={n_devices}, V={n_virtual}, M={n_microbatches}): "
+            + "; ".join(str(h) for h in report.hazards[:8]))
+
+
+def maybe_verify_serving(n_devices: int, n_slots: int) -> None:
+    """Build-time hook for the serving executor's round-robin ring
+    (``serving.engine.make_serving_step_fn``)."""
+    if not verify_tables_enabled():
+        return
+    from .table_check import check_serving_ring
+    report = check_serving_ring(n_devices, n_slots)
+    if not report.ok:
+        raise ValueError(
+            f"serving ring verification failed (D={n_devices}, "
+            f"n_slots={n_slots}): "
+            + "; ".join(str(h) for h in report.hazards[:8]))
+
+
+_LAZY = {
+    "Hazard": ("table_check", "Hazard"),
+    "TableReport": ("table_check", "TableReport"),
+    "check_table": ("table_check", "check_table"),
+    "check_forward_table": ("table_check", "check_forward_table"),
+    "check_serving_ring": ("table_check", "check_serving_ring"),
+    "static_analysis_section": ("table_check", "static_analysis_section"),
+    "JaxprAudit": ("jaxpr_audit", "JaxprAudit"),
+    "audit_jaxpr": ("jaxpr_audit", "audit_jaxpr"),
+    "audit_fn": ("jaxpr_audit", "audit_fn"),
+    "LintFinding": ("repo_lint", "LintFinding"),
+    "lint_repo": ("repo_lint", "lint_repo"),
+    "lint_source": ("repo_lint", "lint_source"),
+    "main": ("cli", "main"),
+    "run_checks": ("cli", "run_checks"),
+    "default_grid": ("cli", "default_grid"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod, attr = _LAZY[name]
+        value = getattr(importlib.import_module(f".{mod}", __name__), attr)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))
+
+
+__all__ = ["VERIFIER_VERSION", "verify_tables_enabled",
+           "maybe_verify_schedule", "maybe_verify_forward_table",
+           "maybe_verify_serving", *sorted(_LAZY)]

@@ -1,0 +1,8 @@
+"""``python -m distributed_training_with_pipeline_parallelism_tpu.analysis``."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

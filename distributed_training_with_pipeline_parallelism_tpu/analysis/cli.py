@@ -1,0 +1,224 @@
+"""CLI driver for the static-analysis passes (also ``scripts/check.py``).
+
+``--tables`` verifies every registered schedule over a config grid plus
+the forward-only table and the serving ring; ``--lint`` runs the repo
+lint; ``--jaxpr`` traces small train/serving step functions on a
+simulated mesh and audits them (needs a jax backend — the script wrapper
+sets up 8 fake CPU devices before any jax import); ``--all`` is all
+three. Exit code 0 iff every requested pass is clean. ``--json PATH``
+writes the full structured report (the CI artifact).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import VERIFIER_VERSION
+
+GridEntry = Tuple[str, int, int, int]  # (schedule, D, V, M)
+
+
+def default_grid() -> List[GridEntry]:
+    """One grid entry per registered schedule x device count x virtual
+    depth, with microbatch counts satisfying each schedule's constraints
+    (1F1B/ZBH1: M >= D; ZBV: M >= 2D; Interleaved: divisibility)."""
+    from ..parallel.schedules import schedule_names
+    grid: List[GridEntry] = []
+    for name in schedule_names():
+        if name == "ZBV":
+            v_options: Tuple[int, ...] = (2,)
+        elif name in ("Interleaved1F1B", "BFS"):
+            v_options = (1, 2)
+        else:
+            v_options = (1,)
+        for D in (2, 4):
+            for V in v_options:
+                for M in sorted({D, 2 * D, 8}):
+                    if name == "ZBV" and M < 2 * D:
+                        continue
+                    if name in ("1F1B", "ZBH1", "Interleaved1F1B") \
+                            and M < D:
+                        continue
+                    if name == "Interleaved1F1B" and V > 1:
+                        rounds = max(1, M // D)
+                        if M % rounds != 0:
+                            continue
+                    grid.append((name, D, V, M))
+    return grid
+
+
+def run_table_checks(grid: Optional[List[GridEntry]] = None
+                     ) -> Dict[str, Any]:
+    from ..parallel.pipeline import _fwd_tick_table
+    from ..parallel.schedules import ScheduleError, compile_schedule
+    from .table_check import (check_forward_table, check_serving_ring,
+                              check_table)
+    reports: List[Dict[str, Any]] = []
+    n_hazards = 0
+    for name, D, V, M in (grid if grid is not None else default_grid()):
+        try:
+            cs = compile_schedule(name, D, V, M)
+        except ScheduleError as e:
+            reports.append({"name": name, "n_devices": D, "n_virtual": V,
+                            "n_microbatches": M, "ok": False,
+                            "n_hazards": 1,
+                            "hazards": [f"compile failed: {e}"]})
+            n_hazards += 1
+            continue
+        reports.append(check_table(cs).summary())
+        n_hazards += reports[-1]["n_hazards"]
+    for D, V, M in ((2, 1, 4), (4, 1, 8), (2, 2, 4)):
+        table, n_slots = _fwd_tick_table(D, V, M)
+        reports.append(check_forward_table(table, D, V, M,
+                                           n_slots).summary())
+        n_hazards += reports[-1]["n_hazards"]
+    for D, M in ((2, 2), (4, 4), (4, 6)):
+        reports.append(check_serving_ring(D, M).summary())
+        n_hazards += reports[-1]["n_hazards"]
+    return {"n_checked": len(reports), "n_hazards": n_hazards,
+            "ok": n_hazards == 0, "reports": reports}
+
+
+def run_lint() -> Dict[str, Any]:
+    from .repo_lint import findings_summary, lint_repo
+    findings = lint_repo()
+    out = findings_summary(findings)
+    out["ok"] = not findings
+    return out
+
+
+def run_jaxpr_audits() -> Dict[str, Any]:
+    """Trace small step functions (nothing executes) and audit them: zero
+    callbacks with telemetry off, collective axes declared on the mesh,
+    and — for the unrolled tick executor — traced ppermute hops equal to
+    the table verifier's predicted comm volume."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import transformer as tfm
+    from ..parallel.mesh import make_mesh
+    from ..parallel.pipeline import _compile, make_pipeline_step
+    from ..utils.config import ModelConfig, ScheduleConfig
+    from .jaxpr_audit import audit_fn
+    from .table_check import check_table
+
+    # 8 layers: divisible by 4 stages (V=1) and 8 stages (V=2 interleave)
+    cfg = ModelConfig(dim=16, n_layers=8, n_heads=2, vocab_size=32,
+                      ffn_dim=32, max_seq_len=8)
+    mesh = make_mesh(n_pipe=4)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jnp.zeros((4, 8), jnp.int32)
+    targets = jnp.zeros((4, 8), jnp.int32)
+    out: Dict[str, Any] = {"cases": [], "ok": True}
+    for name, V, M in (("GPipe", 1, 4), ("1F1B", 1, 4),
+                       ("Interleaved1F1B", 2, 4)):
+        sched = ScheduleConfig(name=name, n_microbatches=M, n_virtual=V)
+        step = make_pipeline_step(cfg, mesh, sched, unroll_ticks=True)
+        predicted = check_table(_compile(name, 4, V, M)).predicted_ppermutes
+        audit = audit_fn(step, params, tokens, targets,
+                         mesh_axes=tuple(mesh.axis_names),
+                         expect_no_callbacks=True,
+                         expected_ppermutes=predicted)
+        case = {"case": f"train/{name}[D=4,V={V},M={M}]",
+                "predicted_ppermutes": predicted, **audit.summary()}
+        out["cases"].append(case)
+        out["ok"] = out["ok"] and audit.ok
+    # serving block: telemetry-free by construction; audit callbacks + axes
+    from ..serving.engine import make_serving_step_fn
+    serve_cfg = ModelConfig(dim=16, n_layers=8, n_heads=2, vocab_size=32,
+                            ffn_dim=32, max_seq_len=16, arch="gpt2")
+    serve_params = tfm.transformer_init(jax.random.key(0), serve_cfg)
+    program = make_serving_step_fn(serve_cfg, mesh, n_slots=4, max_len=8,
+                                   prompt_max=4, out_max=4)
+    stacked, embed, head = program.prepare(serve_params)
+    state = program.init_state()
+    audit = audit_fn(program.step, stacked, embed, head, state,
+                     mesh_axes=tuple(mesh.axis_names),
+                     expect_no_callbacks=True)
+    out["cases"].append({"case": "serving[D=4,n_slots=4]",
+                         **audit.summary()})
+    out["ok"] = out["ok"] and audit.ok
+    return out
+
+
+def run_checks(tables: bool = True, lint: bool = True,
+               jaxpr: bool = False) -> Dict[str, Any]:
+    report: Dict[str, Any] = {"verifier_version": VERIFIER_VERSION}
+    ok = True
+    if tables:
+        report["tables"] = run_table_checks()
+        ok = ok and report["tables"]["ok"]
+    if lint:
+        report["lint"] = run_lint()
+        ok = ok and report["lint"]["ok"]
+    if jaxpr:
+        report["jaxpr"] = run_jaxpr_audits()
+        ok = ok and report["jaxpr"]["ok"]
+    report["ok"] = ok
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_training_with_pipeline_parallelism_tpu"
+             ".analysis",
+        description="Static analysis: table verifier, repo lint, jaxpr "
+                    "audit (docs/static_analysis.md)")
+    ap.add_argument("--tables", action="store_true",
+                    help="verify every registered schedule's tick table "
+                         "over the config grid")
+    ap.add_argument("--lint", action="store_true", help="run the repo lint")
+    ap.add_argument("--jaxpr", action="store_true",
+                    help="trace + audit step functions (needs a jax "
+                         "backend with >= 4 pipe devices)")
+    ap.add_argument("--all", action="store_true", help="all three passes")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the structured report to PATH")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the per-pass console summary")
+    args = ap.parse_args(argv)
+
+    tables = args.tables or args.all
+    lint = args.lint or args.all
+    jaxpr = args.jaxpr or args.all
+    if not (tables or lint or jaxpr):
+        tables = lint = True  # cheap default: no jax import needed
+
+    report = run_checks(tables=tables, lint=lint, jaxpr=jaxpr)
+
+    if not args.quiet:
+        if "tables" in report:
+            t = report["tables"]
+            print(f"tables: {t['n_checked']} checked, "
+                  f"{t['n_hazards']} hazards")
+            for r in t["reports"]:
+                for h in r.get("hazards", []):
+                    print(f"  {r.get('name')}: {h}")
+        if "lint" in report:
+            li = report["lint"]
+            print(f"lint: {li['n_findings']} findings")
+            for f in li["findings"]:
+                print(f"  {f}")
+        if "jaxpr" in report:
+            for case in report["jaxpr"]["cases"]:
+                status = "ok" if not case["problems"] else "FAIL"
+                print(f"jaxpr: {case['case']}: {status} "
+                      f"(ppermutes={case['ppermute_count']}, "
+                      f"callbacks={case['n_callbacks']})")
+                for p in case["problems"]:
+                    print(f"  {p}")
+        print(f"check: {'OK' if report['ok'] else 'FAILED'}")
+
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,220 @@
+"""AST-based repo lint: project rules the test suite cannot see.
+
+Three rules, each encoding a contract documented elsewhere in the repo
+(docs/static_analysis.md explains how to add more):
+
+``scan-body-host-call``
+    No ``time.time()`` / ``time.perf_counter()``, ``.item()``, or
+    ``np.asarray`` / ``numpy.asarray`` inside tick/scan bodies — a host
+    sync or host-side constant inside a traced loop body either fails
+    under jit or silently re-traces. A "tick/scan body" is any function
+    passed to ``lax.scan`` / ``lax.fori_loop`` / ``lax.while_loop``
+    (positionally or by name), any function named ``tick``, and every
+    function nested inside one. ``jnp.asarray`` is fine (traced).
+
+``init-lazy-exports``
+    Package ``__init__.py`` files must not eagerly import submodules:
+    re-exports go through the ``_LAZY`` + ``__getattr__`` pattern of the
+    top-level ``__init__`` so ``import dtpp`` stays cheap. The only
+    allowlisted eager import is ``utils.config`` (pure-python dataclasses
+    the one-import surface needs at definition time).
+
+``jit-named-scope``
+    No bare ``jax.jit`` in ``parallel/`` modules without a
+    ``jax.named_scope`` somewhere in the same file: profile legibility
+    (docs/observability.md) requires every jitted entry point to carry
+    named scopes so XProf timelines attribute time to pipeline phases.
+
+The linter is stdlib-only (``ast``) — no jax import, safe for CI legs
+that run before any backend exists.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+# __init__.py relative imports that may stay eager (see rule docstring).
+LAZY_IMPORT_ALLOWLIST = frozenset({"utils.config"})
+
+# Calls banned inside tick/scan bodies: (dotted-name, message).
+_BANNED_DOTTED = {
+    "time.time": "host clock read inside a traced tick/scan body",
+    "time.perf_counter": "host clock read inside a traced tick/scan body",
+    "np.asarray": "host-side numpy materialization inside a traced "
+                  "tick/scan body (use jnp.asarray)",
+    "numpy.asarray": "host-side numpy materialization inside a traced "
+                     "tick/scan body (use jnp.asarray)",
+}
+
+_SCAN_ENTRY_POINTS = {"scan", "fori_loop", "while_loop"}
+# positional index of the body callable per entry point
+_BODY_ARG_INDEX = {"scan": 0, "fori_loop": 2, "while_loop": 1}
+
+
+@dataclasses.dataclass(frozen=True)
+class LintFinding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _scan_body_names(tree: ast.AST) -> Tuple[Set[str], List[ast.Lambda]]:
+    """Names of functions passed as scan/fori/while bodies, plus inline
+    lambda bodies."""
+    names: Set[str] = set()
+    lambdas: List[ast.Lambda] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted_name(node.func)
+        if callee is None:
+            continue
+        leaf = callee.rsplit(".", 1)[-1]
+        if leaf not in _SCAN_ENTRY_POINTS:
+            continue
+        idx = _BODY_ARG_INDEX[leaf]
+        if idx < len(node.args):
+            body = node.args[idx]
+            if isinstance(body, ast.Name):
+                names.add(body.id)
+            elif isinstance(body, ast.Lambda):
+                lambdas.append(body)
+    return names, lambdas
+
+
+def _check_banned_calls(scope: ast.AST, path: str,
+                        findings: List[LintFinding]) -> None:
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted_name(node.func)
+        if dotted in _BANNED_DOTTED:
+            findings.append(LintFinding(
+                path, node.lineno, "scan-body-host-call",
+                f"{dotted}(): {_BANNED_DOTTED[dotted]}"))
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "item" and not node.args):
+            findings.append(LintFinding(
+                path, node.lineno, "scan-body-host-call",
+                ".item(): host sync inside a traced tick/scan body"))
+
+
+def _lint_scan_bodies(tree: ast.AST, path: str,
+                      findings: List[LintFinding]) -> None:
+    body_names, body_lambdas = _scan_body_names(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+                node.name in body_names or node.name == "tick"):
+            _check_banned_calls(node, path, findings)
+    for lam in body_lambdas:
+        _check_banned_calls(lam, path, findings)
+
+
+def _lint_init_exports(tree: ast.Module, path: str,
+                       findings: List[LintFinding]) -> None:
+    for node in tree.body:
+        if isinstance(node, ast.ImportFrom) and node.level >= 1:
+            module = node.module or ""
+            if module in LAZY_IMPORT_ALLOWLIST:
+                continue
+            findings.append(LintFinding(
+                path, node.lineno, "init-lazy-exports",
+                f"eager relative import of {'.' * node.level}{module} in "
+                f"__init__.py — route re-exports through the _LAZY/"
+                f"__getattr__ pattern"))
+
+
+def _lint_jit_named_scope(tree: ast.AST, path: str,
+                          findings: List[LintFinding]) -> None:
+    jit_sites: List[int] = []
+    has_named_scope = False
+    for node in ast.walk(tree):
+        dotted = None
+        if isinstance(node, ast.Call):
+            dotted = _dotted_name(node.func)
+        elif isinstance(node, ast.Attribute):
+            dotted = _dotted_name(node)
+        if dotted == "jax.jit":
+            jit_sites.append(node.lineno)
+        elif dotted == "jax.named_scope":
+            has_named_scope = True
+    if not has_named_scope:
+        # de-dup Call/Attribute double counting of the same site
+        for line in sorted(set(jit_sites)):
+            findings.append(LintFinding(
+                path, line, "jit-named-scope",
+                "jax.jit in parallel/ without any jax.named_scope in the "
+                "module — jitted entry points must carry named scopes "
+                "for profile attribution"))
+
+
+def lint_source(path: str, source: str,
+                package_relpath: Optional[str] = None) -> List[LintFinding]:
+    """Lint one python source. ``package_relpath`` is the path relative to
+    the package root (drives per-directory rules); defaults to ``path``."""
+    rel = package_relpath if package_relpath is not None else path
+    findings: List[LintFinding] = []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        findings.append(LintFinding(path, e.lineno or 0, "syntax",
+                                    f"unparsable: {e.msg}"))
+        return findings
+    _lint_scan_bodies(tree, path, findings)
+    if os.path.basename(rel) == "__init__.py":
+        _lint_init_exports(tree, path, findings)
+    parts = rel.replace(os.sep, "/").split("/")
+    if "parallel" in parts[:-1]:
+        _lint_jit_named_scope(tree, path, findings)
+    return findings
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_repo(root: Optional[str] = None) -> List[LintFinding]:
+    """Lint every ``.py`` file under the package (default: this package's
+    own root). Returns findings sorted by (path, line)."""
+    root = root or package_root()
+    findings: List[LintFinding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git", "build")]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            findings.extend(lint_source(path, src, package_relpath=rel))
+    findings.sort(key=lambda f: (f.path, f.line))
+    return findings
+
+
+def findings_summary(findings: List[LintFinding]) -> Dict[str, object]:
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {"n_findings": len(findings), "by_rule": by_rule,
+            "findings": [str(f) for f in findings]}

@@ -1,0 +1,766 @@
+"""Static table verifier: slot-lifetime dataflow analysis over tick tables.
+
+:func:`verify_table` (``parallel.schedules``) is the compile-time gate —
+it raises on the *first* violation of the store/compute/permute contract.
+This module is the analysis-grade twin: :func:`check_table` interprets the
+same contract over a ``[T, D, N_COLS]`` table but keeps going, returning a
+structured :class:`TableReport` whose :class:`Hazard` entries carry an
+exact (device, tick, column) location for every RAW/WAR/WAW violation,
+every unpaired ppermute send/recv, and every route inconsistency against
+:func:`fwd_route` / :func:`bwd_route` — which is what mutation testing and
+CI gating need (a single opaque raise names one symptom; the report names
+the corrupted cell).
+
+On top of the hazard scan the report carries the *static* facts a clean
+table proves:
+
+- per-device slot high-water marks (``act_slots_used`` / ``act_live_peak``
+  and the grad twins) — a static activation-memory bound per schedule;
+- per-ring-channel comm volume: ``cells`` (table store entries) and
+  ``hop_ticks`` (ticks with >= 1 store on the channel). ``hop_ticks`` is
+  exactly the number of ``ppermute`` hops the unrolled executor emits per
+  channel, because its dead-hop elision drops a channel's ppermute at
+  tick ``t`` iff *no* device banks from it at tick ``t+1``
+  (``pipeline.transfers``); the jaxpr auditor pins traced counts to this.
+- ``compress_schedule`` -> ``replay_phases`` bit-exact roundtrip and
+  ``table_unit_activity`` unit counts against the action set
+  ``validate_order`` demands for (D, V, M, split_backward).
+
+Everything here is numpy over the table plus the compiled metadata — no
+jax import, so the checks run at table-build time (``DTPP_VERIFY_TABLES``)
+for the cost of a small python interpretation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..parallel.schedules import (COL_BWD_ASLOT, COL_BWD_GSLOT,
+                                  COL_BWD_LOCAL_SLOT, COL_BWD_M, COL_BWD_V,
+                                  COL_FWD_LOCAL_SLOT, COL_FWD_M, COL_FWD_SLOT,
+                                  COL_FWD_V, COL_STORE_B_POS_SLOT,
+                                  COL_STORE_B_SLOT, COL_STORE_F_NEG_SLOT,
+                                  COL_STORE_F_SLOT, COL_W_ASLOT, COL_W_GSLOT,
+                                  COL_W_M, COL_W_V, N_COLS, CompiledSchedule,
+                                  ScheduleError, bwd_route, compress_schedule,
+                                  fwd_route, phase_spans,
+                                  placement_stage_of, replay_phases,
+                                  table_unit_activity)
+
+# Column-index -> name, for exact hazard locations ("which cell is wrong").
+COLUMN_NAMES: Dict[int, str] = {
+    COL_STORE_F_SLOT: "COL_STORE_F_SLOT",
+    COL_FWD_V: "COL_FWD_V",
+    COL_FWD_M: "COL_FWD_M",
+    COL_FWD_SLOT: "COL_FWD_SLOT",
+    COL_STORE_B_SLOT: "COL_STORE_B_SLOT",
+    COL_BWD_V: "COL_BWD_V",
+    COL_BWD_M: "COL_BWD_M",
+    COL_BWD_ASLOT: "COL_BWD_ASLOT",
+    COL_BWD_GSLOT: "COL_BWD_GSLOT",
+    COL_W_V: "COL_W_V",
+    COL_W_M: "COL_W_M",
+    COL_W_ASLOT: "COL_W_ASLOT",
+    COL_W_GSLOT: "COL_W_GSLOT",
+    COL_FWD_LOCAL_SLOT: "COL_FWD_LOCAL_SLOT",
+    COL_STORE_F_NEG_SLOT: "COL_STORE_F_NEG_SLOT",
+    COL_BWD_LOCAL_SLOT: "COL_BWD_LOCAL_SLOT",
+    COL_STORE_B_POS_SLOT: "COL_STORE_B_POS_SLOT",
+}
+
+# The four ring channels: (report key, bank column, sender ring offset).
+# A value banked from channel (key, col) at tick t was sent at t-1 by the
+# device ``(d - offset) % D`` — the executor's ppermute permutation.
+RING_CHANNELS: Tuple[Tuple[str, int, int], ...] = (
+    ("fwd_ring_pos", COL_STORE_F_SLOT, +1),
+    ("bwd_ring_neg", COL_STORE_B_SLOT, -1),
+    ("fwd_ring_neg", COL_STORE_F_NEG_SLOT, -1),
+    ("bwd_ring_pos", COL_STORE_B_POS_SLOT, +1),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Hazard:
+    """One verified violation, located to the exact table cell.
+
+    ``kind`` is a stable machine-readable tag; ``device``/``tick`` are -1
+    for table-global findings (unit-count or compression mismatches).
+    """
+
+    kind: str
+    device: int
+    tick: int
+    column: str
+    detail: str
+
+    def location(self) -> str:
+        return f"(device {self.device}, tick {self.tick}, {self.column})"
+
+    def __str__(self) -> str:
+        return f"{self.location()} {self.kind}: {self.detail}"
+
+
+@dataclasses.dataclass
+class TableReport:
+    """Structured result of one static table verification."""
+
+    name: str
+    kind: str  # "train" | "forward" | "serving"
+    n_devices: int
+    n_virtual: int
+    n_microbatches: int
+    placement: str
+    split_backward: bool
+    makespan: int
+    hazards: List[Hazard]
+    # static memory bound: per-device max slot index in use + 1, and the
+    # peak number of simultaneously-live values (<= slots used)
+    act_slots_used: List[int]
+    grad_slots_used: List[int]
+    act_live_peak: List[int]
+    grad_live_peak: List[int]
+    n_act_slots: int
+    n_grad_slots: int
+    # channel key -> {"cells": stores in the table, "hop_ticks": ticks with
+    # >= 1 store — the unrolled executor's emitted-ppermute count}
+    comm: Dict[str, Dict[str, int]]
+    unit_counts: Dict[str, int]
+    compression: Dict[str, int]
+
+    @property
+    def ok(self) -> bool:
+        return not self.hazards
+
+    @property
+    def predicted_ppermutes(self) -> int:
+        """Total ppermute hops the unrolled tick executor emits for this
+        table: per live channel, one hop per tick that banks from it
+        (``pipeline.transfers`` elides the rest). Reverse channels only
+        exist when the table routes through them."""
+        keys = ["fwd_ring_pos", "bwd_ring_neg"]
+        if self.uses_reverse_routes:
+            keys += ["fwd_ring_neg", "bwd_ring_pos"]
+        return sum(self.comm[k]["hop_ticks"] for k in keys if k in self.comm)
+
+    @property
+    def uses_reverse_routes(self) -> bool:
+        return any(self.comm.get(k, {}).get("cells", 0) > 0
+                   for k in ("fwd_ring_neg", "bwd_ring_pos",
+                             "fwd_local", "bwd_local"))
+
+    def summary(self) -> Dict[str, object]:
+        """JSON-able digest (embedded in check reports and RunReport's
+        ``static_analysis`` section)."""
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "n_devices": self.n_devices,
+            "n_virtual": self.n_virtual,
+            "n_microbatches": self.n_microbatches,
+            "placement": self.placement,
+            "split_backward": self.split_backward,
+            "makespan": self.makespan,
+            "ok": self.ok,
+            "n_hazards": len(self.hazards),
+            "hazards": [str(h) for h in self.hazards],
+            "act_slots_used": list(self.act_slots_used),
+            "grad_slots_used": list(self.grad_slots_used),
+            "act_live_peak": list(self.act_live_peak),
+            "grad_live_peak": list(self.grad_live_peak),
+            "n_act_slots": self.n_act_slots,
+            "n_grad_slots": self.n_grad_slots,
+            "comm": {k: dict(v) for k, v in self.comm.items()},
+            "predicted_ppermutes": self.predicted_ppermutes,
+            "unit_counts": dict(self.unit_counts),
+            "compression": dict(self.compression),
+        }
+
+
+def _comm_volume(table: np.ndarray) -> Dict[str, Dict[str, int]]:
+    """Per-channel stores (``cells``) and live hop ticks (``hop_ticks``).
+
+    A store at tick t is fed by the ppermute at the end of tick t-1, so
+    hop ticks are counted over ``t >= 1`` (a tick-0 store reads the zero
+    initial registers and is flagged as a hazard elsewhere).
+    """
+    out: Dict[str, Dict[str, int]] = {}
+    for key, col, _ in RING_CHANNELS:
+        stores = table[:, :, col] >= 0
+        out[key] = {
+            "cells": int(stores.sum()),
+            "hop_ticks": int(stores[1:].any(axis=1).sum()),
+        }
+    for key, col in (("fwd_local", COL_FWD_LOCAL_SLOT),
+                     ("bwd_local", COL_BWD_LOCAL_SLOT)):
+        out[key] = {"cells": int((table[:, :, col] >= 0).sum()),
+                    "hop_ticks": 0}
+    return out
+
+
+class _SlotFile:
+    """One device's slot-addressed buffer under symbolic interpretation,
+    with value liveness (outstanding expected reads) for WAR detection."""
+
+    def __init__(self, label: str, n_slots: int):
+        self.label = label
+        self.n_slots = n_slots
+        self.value: Dict[int, Tuple] = {}       # slot -> symbolic value
+        self.reads_left: Dict[int, List[int]] = {}  # slot -> pending read ticks
+        self.max_slot = -1
+        self.live = 0
+        self.live_peak = 0
+
+    def write(self, slot: int, val: Tuple, t: int, d: int, column: int,
+              expected_reads: List[int], hazards: List[Hazard],
+              written_this_tick: Dict[int, int]) -> None:
+        self.max_slot = max(self.max_slot, slot)
+        if slot in written_this_tick:
+            hazards.append(Hazard(
+                "double-store", d, t, COLUMN_NAMES[column],
+                f"{self.label} slot {slot} written twice in one tick "
+                f"(first via {COLUMN_NAMES[written_this_tick[slot]]})"))
+        written_this_tick[slot] = column
+        pending = [r for r in self.reads_left.get(slot, []) if r >= t]
+        if pending:
+            hazards.append(Hazard(
+                "overwrite-live", d, t, COLUMN_NAMES[column],
+                f"{self.label} slot {slot} overwritten while "
+                f"{self.value.get(slot)} still has reads at ticks "
+                f"{pending}"))
+        else:
+            if self.reads_left.get(slot):
+                self.live -= 1  # previous value retired cleanly
+        self.value[slot] = val
+        self.reads_left[slot] = list(expected_reads)
+        if self.reads_left[slot]:
+            self.live += 1
+            self.live_peak = max(self.live_peak, self.live)
+
+    def read(self, slot: int, expect: Tuple, t: int, d: int, column: int,
+             what: str, hazards: List[Hazard]) -> None:
+        self.max_slot = max(self.max_slot, slot)
+        got = self.value.get(slot)
+        if got != expect:
+            hazards.append(Hazard(
+                "read-wrong-value", d, t, COLUMN_NAMES[column],
+                f"{what} expected {expect} in {self.label} slot {slot}, "
+                f"found {got}"))
+        pend = self.reads_left.get(slot)
+        if pend and t in pend:
+            pend.remove(t)
+            if not pend:
+                self.live -= 1
+
+
+def _expected_reads(table: np.ndarray, placement: str, D: int
+                    ) -> Tuple[Dict, Dict]:
+    """Read schedule per device and value, derived from the table itself:
+    ``act_reads[d][(s, m)]`` / ``grad_reads[d][(s, m)]`` -> sorted ticks at
+    which the table claims to read that value. Drives WAR liveness (a
+    corrupted read column simply shifts the claimed schedule — the
+    symbolic value check still catches the mismatch)."""
+    T = table.shape[0]
+    act_reads: Dict[int, Dict[Tuple[int, int], List[int]]] = \
+        {d: {} for d in range(D)}
+    grad_reads: Dict[int, Dict[Tuple[int, int], List[int]]] = \
+        {d: {} for d in range(D)}
+    for t in range(T):
+        for d in range(D):
+            row = table[t, d]
+            if row[COL_FWD_M] >= 0:
+                s = placement_stage_of(placement, d, int(row[COL_FWD_V]), D)
+                act_reads[d].setdefault((s, int(row[COL_FWD_M])),
+                                        []).append(t)
+            for vcol, mcol in ((COL_BWD_V, COL_BWD_M), (COL_W_V, COL_W_M)):
+                if row[mcol] >= 0:
+                    s = placement_stage_of(placement, d, int(row[vcol]), D)
+                    m = int(row[mcol])
+                    act_reads[d].setdefault((s, m), []).append(t)
+                    grad_reads[d].setdefault((s, m), []).append(t)
+    return act_reads, grad_reads
+
+
+def check_table(cs: CompiledSchedule) -> TableReport:
+    """Statically verify a compiled training schedule's tick table.
+
+    Interprets the executor contract cell by cell (arrival stores, then
+    F/B/W units, then routed sends), accumulating every violation as a
+    located :class:`Hazard` instead of raising — see the module docstring
+    for the full check list."""
+    table = np.asarray(cs.table)
+    T, D = table.shape[0], cs.n_devices
+    S, M = cs.n_stages, cs.n_microbatches
+    pl = cs.placement
+    hazards: List[Hazard] = []
+
+    act_reads, grad_reads = _expected_reads(table, pl, D)
+    act = [_SlotFile("act_buf", cs.n_act_slots) for _ in range(D)]
+    grad = [_SlotFile("grad_buf", cs.n_grad_slots) for _ in range(D)]
+
+    def check_bounds(slot, n_slots, t, d, col, label):
+        if slot >= n_slots:
+            hazards.append(Hazard(
+                "slot-out-of-bounds", d, t, COLUMN_NAMES[col],
+                f"{label} slot {slot} >= declared n_slots {n_slots}"))
+
+    # channel registers: value delivered by last tick's ppermute per channel
+    regs: Dict[str, List[Optional[Tuple]]] = {
+        key: [None] * D for key, _, _ in RING_CHANNELS}
+    fwd_done: Dict[Tuple[int, int], int] = {}
+    bwd_done: Dict[Tuple[int, int], int] = {}
+    w_done: Dict[Tuple[int, int], int] = {}
+    b_slots: Dict[Tuple[int, int, int], Tuple[int, int]] = {}
+    f_slots: Dict[Tuple[int, int], int] = {}
+
+    for t in range(T):
+        sends: Dict[str, List[Optional[Tuple]]] = {
+            key: [None] * D for key, _, _ in RING_CHANNELS}
+        for d in range(D):
+            row = table[t, d]
+            written_act: Dict[int, int] = {}
+            written_grad: Dict[int, int] = {}
+
+            # 1. bank ring arrivals (reads channel registers filled at t-1)
+            for key, col, _ in RING_CHANNELS:
+                slot = int(row[col])
+                if slot < 0:
+                    continue
+                buf = act[d] if col in (COL_STORE_F_SLOT,
+                                        COL_STORE_F_NEG_SLOT) else grad[d]
+                reads = act_reads if buf is act[d] else grad_reads
+                check_bounds(slot, buf.n_slots, t, d, col, buf.label)
+                val = regs[key][d]
+                if val is None:
+                    hazards.append(Hazard(
+                        "store-empty-register", d, t, COLUMN_NAMES[col],
+                        f"{key} store into {buf.label} slot {slot} but no "
+                        f"value arrived on the channel (dropped or "
+                        f"misrouted send at tick {t - 1})"))
+                    continue
+                buf.write(slot, val, t, d, col,
+                          reads[d].get((val[1], val[2]), []), hazards,
+                          written_act if buf is act[d] else written_grad)
+
+            # 2. forward unit
+            if row[COL_FWD_M] >= 0:
+                s = placement_stage_of(pl, d, int(row[COL_FWD_V]), D)
+                m = int(row[COL_FWD_M])
+                slot = int(row[COL_FWD_SLOT])
+                check_bounds(slot, cs.n_act_slots, t, d, COL_FWD_SLOT,
+                             "act_buf")
+                if s == 0:
+                    # embed computed in place: the write IS this tick's F
+                    act[d].write(slot, ("act", 0, m), t, d, COL_FWD_SLOT,
+                                 act_reads[d].get((0, m), []), hazards,
+                                 written_act)
+                act[d].read(slot, ("act", s, m), t, d, COL_FWD_SLOT,
+                            f"F(stage={s}, mb={m})", hazards)
+                if (s, m) in fwd_done:
+                    hazards.append(Hazard(
+                        "duplicate-unit", d, t, COLUMN_NAMES[COL_FWD_M],
+                        f"F(stage={s}, mb={m}) already ran at tick "
+                        f"{fwd_done[(s, m)]}"))
+                fwd_done[(s, m)] = t
+                f_slots[(s, m)] = slot
+                # route the output
+                if s < S - 1:
+                    route = fwd_route(pl, s, D)
+                    if route == "local":
+                        lslot = int(row[COL_FWD_LOCAL_SLOT])
+                        if lslot < 0:
+                            hazards.append(Hazard(
+                                "route-mismatch", d, t,
+                                "COL_FWD_LOCAL_SLOT",
+                                f"F(stage={s}) routes 'local' but "
+                                f"COL_FWD_LOCAL_SLOT is unset"))
+                        else:
+                            check_bounds(lslot, cs.n_act_slots, t, d,
+                                         COL_FWD_LOCAL_SLOT, "act_buf")
+                            act[d].write(
+                                lslot, ("act", s + 1, m), t, d,
+                                COL_FWD_LOCAL_SLOT,
+                                act_reads[d].get((s + 1, m), []), hazards,
+                                written_act)
+                    else:
+                        key = ("fwd_ring_pos" if route == "+1"
+                               else "fwd_ring_neg")
+                        sends[key][d] = ("act", s + 1, m)
+                        if row[COL_FWD_LOCAL_SLOT] >= 0:
+                            hazards.append(Hazard(
+                                "route-mismatch", d, t,
+                                "COL_FWD_LOCAL_SLOT",
+                                f"F(stage={s}) routes '{route}' ring but "
+                                f"COL_FWD_LOCAL_SLOT is set"))
+                elif row[COL_FWD_LOCAL_SLOT] >= 0:
+                    hazards.append(Hazard(
+                        "route-mismatch", d, t, "COL_FWD_LOCAL_SLOT",
+                        f"last stage F(stage={s}) must not route a local "
+                        f"hop"))
+            elif row[COL_FWD_LOCAL_SLOT] >= 0:
+                hazards.append(Hazard(
+                    "route-mismatch", d, t, "COL_FWD_LOCAL_SLOT",
+                    "local fwd hop without an active forward unit"))
+
+            # 3. backward (full or dgrad) unit
+            if row[COL_BWD_M] >= 0:
+                s = placement_stage_of(pl, d, int(row[COL_BWD_V]), D)
+                m = int(row[COL_BWD_M])
+                aslot = int(row[COL_BWD_ASLOT])
+                check_bounds(aslot, cs.n_act_slots, t, d, COL_BWD_ASLOT,
+                             "act_buf")
+                act[d].read(aslot, ("act", s, m), t, d, COL_BWD_ASLOT,
+                            f"B(stage={s}, mb={m}) saved input", hazards)
+                gslot = int(row[COL_BWD_GSLOT])
+                if s < S - 1:
+                    check_bounds(gslot, cs.n_grad_slots, t, d,
+                                 COL_BWD_GSLOT, "grad_buf")
+                    grad[d].read(gslot, ("gout", s, m), t, d, COL_BWD_GSLOT,
+                                 f"B(stage={s}, mb={m}) incoming cotangent",
+                                 hazards)
+                if (s, m) in bwd_done:
+                    hazards.append(Hazard(
+                        "duplicate-unit", d, t, COLUMN_NAMES[COL_BWD_M],
+                        f"B(stage={s}, mb={m}) already ran at tick "
+                        f"{bwd_done[(s, m)]}"))
+                bwd_done[(s, m)] = t
+                b_slots[(d, s, m)] = (aslot, gslot)
+                if s > 0:
+                    route = bwd_route(pl, s, D)
+                    if route == "local":
+                        lslot = int(row[COL_BWD_LOCAL_SLOT])
+                        if lslot < 0:
+                            hazards.append(Hazard(
+                                "route-mismatch", d, t,
+                                "COL_BWD_LOCAL_SLOT",
+                                f"B(stage={s}) routes 'local' but "
+                                f"COL_BWD_LOCAL_SLOT is unset"))
+                        else:
+                            check_bounds(lslot, cs.n_grad_slots, t, d,
+                                         COL_BWD_LOCAL_SLOT, "grad_buf")
+                            grad[d].write(
+                                lslot, ("gout", s - 1, m), t, d,
+                                COL_BWD_LOCAL_SLOT,
+                                grad_reads[d].get((s - 1, m), []), hazards,
+                                written_grad)
+                    else:
+                        key = ("bwd_ring_neg" if route == "-1"
+                               else "bwd_ring_pos")
+                        sends[key][d] = ("gout", s - 1, m)
+                        if row[COL_BWD_LOCAL_SLOT] >= 0:
+                            hazards.append(Hazard(
+                                "route-mismatch", d, t,
+                                "COL_BWD_LOCAL_SLOT",
+                                f"B(stage={s}) routes '{route}' ring but "
+                                f"COL_BWD_LOCAL_SLOT is set"))
+                elif row[COL_BWD_LOCAL_SLOT] >= 0:
+                    hazards.append(Hazard(
+                        "route-mismatch", d, t, "COL_BWD_LOCAL_SLOT",
+                        "stage 0 backward must not route a cotangent"))
+            elif row[COL_BWD_LOCAL_SLOT] >= 0:
+                hazards.append(Hazard(
+                    "route-mismatch", d, t, "COL_BWD_LOCAL_SLOT",
+                    "local bwd hop without an active backward unit"))
+
+            # 4. weight-grad unit (split schedules)
+            if row[COL_W_M] >= 0:
+                s = placement_stage_of(pl, d, int(row[COL_W_V]), D)
+                m = int(row[COL_W_M])
+                aslot = int(row[COL_W_ASLOT])
+                gslot = int(row[COL_W_GSLOT])
+                check_bounds(aslot, cs.n_act_slots, t, d, COL_W_ASLOT,
+                             "act_buf")
+                act[d].read(aslot, ("act", s, m), t, d, COL_W_ASLOT,
+                            f"W(stage={s}, mb={m}) saved input", hazards)
+                if s < S - 1:
+                    check_bounds(gslot, cs.n_grad_slots, t, d, COL_W_GSLOT,
+                                 "grad_buf")
+                    grad[d].read(gslot, ("gout", s, m), t, d, COL_W_GSLOT,
+                                 f"W(stage={s}, mb={m}) incoming cotangent",
+                                 hazards)
+                if (s, m) in w_done:
+                    hazards.append(Hazard(
+                        "duplicate-unit", d, t, COLUMN_NAMES[COL_W_M],
+                        f"W(stage={s}, mb={m}) already ran at tick "
+                        f"{w_done[(s, m)]}"))
+                w_done[(s, m)] = t
+                # W must alias the B unit's saved slots, never a recycled
+                # copy (split-backward contract; stage 0 has no B — its
+                # saved input is F(0, m)'s own slot)
+                if (d, s, m) in b_slots:
+                    ba, bg = b_slots[(d, s, m)]
+                    if aslot != ba:
+                        hazards.append(Hazard(
+                            "w-slot-alias", d, t, "COL_W_ASLOT",
+                            f"W(stage={s}, mb={m}) saved-input slot "
+                            f"{aslot} != B's slot {ba}"))
+                    if s < S - 1 and gslot != bg:
+                        hazards.append(Hazard(
+                            "w-slot-alias", d, t, "COL_W_GSLOT",
+                            f"W(stage={s}, mb={m}) cotangent slot {gslot} "
+                            f"!= B's slot {bg}"))
+                elif s == 0 and (0, m) in f_slots \
+                        and aslot != f_slots[(0, m)]:
+                    hazards.append(Hazard(
+                        "w-slot-alias", d, t, "COL_W_ASLOT",
+                        f"W(stage=0, mb={m}) saved-input slot {aslot} != "
+                        f"F(0, {m})'s slot {f_slots[(0, m)]}"))
+
+        # 5. send/recv pairing per ring direction, then rotate registers.
+        # A send with no matching next-tick store silently drops data; a
+        # store with no matching previous-tick send banks garbage — both
+        # are located at the store cell. (A tick-0 store can pair with
+        # nothing: the channel registers start empty.)
+        for key, col, offset in RING_CHANNELS:
+            if t == 0:
+                for d in range(D):
+                    if table[0, d, col] >= 0:
+                        hazards.append(Hazard(
+                            "recv-unpaired", d, 0, COLUMN_NAMES[col],
+                            f"{key} store at tick 0 precedes any send"))
+            for d in range(D):
+                val = sends[key][d]
+                dst = (d + offset) % D
+                if val is not None:
+                    if t + 1 >= T or table[t + 1, dst, col] < 0:
+                        hazards.append(Hazard(
+                            "send-unpaired", dst, t + 1,
+                            COLUMN_NAMES[col],
+                            f"{key} send of {val} from device {d} at tick "
+                            f"{t} has no receiving store"))
+                src = (d - offset) % D
+                if (t + 1 < T and table[t + 1, d, col] >= 0
+                        and sends[key][src] is None):
+                    hazards.append(Hazard(
+                        "recv-unpaired", d, t + 1, COLUMN_NAMES[col],
+                        f"{key} store at tick {t + 1} has no matching "
+                        f"send from device {src} at tick {t}"))
+            # rotate: after the ppermute, device d holds what (d - offset)
+            # sent — the channel register is indexed by receiver
+            regs[key] = [sends[key][(d - offset) % D] for d in range(D)]
+
+    # 6. unit counts vs the action set validate_order demands
+    activity = table_unit_activity(table).sum(axis=(0, 1))
+    n_f, n_b, n_w = int(activity[0]), int(activity[1]), int(activity[2])
+    want_f = S * M
+    want_b = (S - 1) * M if cs.split_backward else S * M
+    want_w = S * M if cs.split_backward else 0
+    for label, got, want, col in (("F", n_f, want_f, COL_FWD_M),
+                                  ("B", n_b, want_b, COL_BWD_M),
+                                  ("W", n_w, want_w, COL_W_M)):
+        if got != want:
+            hazards.append(Hazard(
+                "unit-count", -1, -1, COLUMN_NAMES[col],
+                f"{label} unit count {got} != expected {want} "
+                f"(S={S}, M={M}, split_backward={cs.split_backward})"))
+    unit_counts = {"F": n_f, "B": n_b, "W": n_w, "idle": int(activity[3])}
+
+    # 7. phase-compression roundtrip (compress self-checks; assert anyway)
+    compression: Dict[str, int] = {}
+    try:
+        phases = compress_schedule(table)
+        if not np.array_equal(replay_phases(phases), table):
+            raise ScheduleError("replay does not reconstruct the table")
+        spans = phase_spans(phases)
+        if sum(n for _, n in spans) != T:
+            raise ScheduleError("phase spans do not tile the table")
+        compression = {"n_phases": len(phases), "n_rows": T}
+    except ScheduleError as e:
+        hazards.append(Hazard("compression-roundtrip", -1, -1, "table",
+                              str(e)))
+
+    return TableReport(
+        name=cs.name, kind="train", n_devices=D, n_virtual=cs.n_virtual,
+        n_microbatches=M, placement=pl, split_backward=cs.split_backward,
+        makespan=T, hazards=hazards,
+        act_slots_used=[a.max_slot + 1 for a in act],
+        grad_slots_used=[g.max_slot + 1 for g in grad],
+        act_live_peak=[a.live_peak for a in act],
+        grad_live_peak=[g.live_peak for g in grad],
+        n_act_slots=cs.n_act_slots, n_grad_slots=cs.n_grad_slots,
+        comm=_comm_volume(table), unit_counts=unit_counts,
+        compression=compression)
+
+
+def check_forward_table(table: np.ndarray, n_devices: int, n_virtual: int,
+                        n_microbatches: int, n_slots: int) -> TableReport:
+    """Verify the 4-column forward-only table (``pipeline._fwd_tick_table``:
+    columns (store_slot, fv, fm, src_slot), wrap placement, +1 ring only)."""
+    table = np.asarray(table)
+    T, D = table.shape[0], n_devices
+    S, M = n_devices * n_virtual, n_microbatches
+    hazards: List[Hazard] = []
+    COLS = {0: "STORE_SLOT", 1: "FWD_V", 2: "FWD_M", 3: "SRC_SLOT"}
+
+    # read schedule: value ("act", s, m) read at F(s, m)'s tick
+    reads: Dict[int, Dict[Tuple[int, int], List[int]]] = \
+        {d: {} for d in range(D)}
+    for t in range(T):
+        for d in range(D):
+            if table[t, d, 2] >= 0 and table[t, d, 3] >= 0:
+                s = int(table[t, d, 1]) * D + d
+                reads[d].setdefault((s, int(table[t, d, 2])), []).append(t)
+
+    bufs = [_SlotFile("act_buf", n_slots) for _ in range(D)]
+    reg: List[Optional[Tuple]] = [None] * D
+    fwd_done: Dict[Tuple[int, int], int] = {}
+    for t in range(T):
+        send: List[Optional[Tuple]] = [None] * D
+        for d in range(D):
+            store, fv, fm, src = (int(x) for x in table[t, d])
+            written: Dict[int, int] = {}
+            if store >= 0:
+                if store >= n_slots:
+                    hazards.append(Hazard(
+                        "slot-out-of-bounds", d, t, COLS[0],
+                        f"store slot {store} >= n_slots {n_slots}"))
+                val = reg[d]
+                if val is None:
+                    hazards.append(Hazard(
+                        "store-empty-register", d, t, COLS[0],
+                        f"store into slot {store} with no arrival "
+                        f"(dropped send at tick {t - 1})"))
+                else:
+                    bufs[d].write(store, val, t, d, COL_STORE_F_SLOT,
+                                  reads[d].get((val[1], val[2]), []),
+                                  hazards, written)
+            if fm >= 0:
+                s = fv * D + d
+                if s > 0:
+                    if src < 0:
+                        hazards.append(Hazard(
+                            "read-wrong-value", d, t, COLS[3],
+                            f"F(stage={s}, mb={fm}) has no input slot"))
+                    else:
+                        bufs[d].read(src, ("act", s, fm), t, d,
+                                     COL_FWD_SLOT,
+                                     f"F(stage={s}, mb={fm})", hazards)
+                if (s, fm) in fwd_done:
+                    hazards.append(Hazard(
+                        "duplicate-unit", d, t, COLS[2],
+                        f"F(stage={s}, mb={fm}) already ran at tick "
+                        f"{fwd_done[(s, fm)]}"))
+                fwd_done[(s, fm)] = t
+                if s + 1 < S:
+                    send[d] = ("act", s + 1, fm)
+        for d in range(D):
+            if t == 0 and table[0, d, 0] >= 0:
+                hazards.append(Hazard(
+                    "recv-unpaired", d, 0, COLS[0],
+                    "fwd store at tick 0 precedes any send"))
+            dst = (d + 1) % D
+            if send[d] is not None and (
+                    t + 1 >= T or table[t + 1, dst, 0] < 0):
+                hazards.append(Hazard(
+                    "send-unpaired", dst, t + 1, COLS[0],
+                    f"fwd send of {send[d]} from device {d} at tick {t} "
+                    f"has no receiving store"))
+            src_dev = (d - 1) % D
+            if (t + 1 < T and table[t + 1, d, 0] >= 0
+                    and send[src_dev] is None):
+                hazards.append(Hazard(
+                    "recv-unpaired", d, t + 1, COLS[0],
+                    f"fwd store at tick {t + 1} has no matching send "
+                    f"from device {src_dev} at tick {t}"))
+        reg = [send[(d - 1) % D] for d in range(D)]
+
+    want = {(s, m) for s in range(S) for m in range(M)}
+    if set(fwd_done) != want:
+        missing = sorted(want - set(fwd_done))[:4]
+        hazards.append(Hazard(
+            "unit-count", -1, -1, COLS[2],
+            f"{len(fwd_done)} forward units != expected {len(want)} "
+            f"(missing {missing})"))
+
+    stores = table[:, :, 0] >= 0
+    comm = {"fwd_ring_pos": {"cells": int(stores.sum()),
+                             "hop_ticks": int(stores[1:].any(axis=1).sum())},
+            "bwd_ring_neg": {"cells": 0, "hop_ticks": 0}}
+    return TableReport(
+        name="forward", kind="forward", n_devices=D, n_virtual=n_virtual,
+        n_microbatches=M, placement="wrap", split_backward=False,
+        makespan=T, hazards=hazards,
+        act_slots_used=[b.max_slot + 1 for b in bufs],
+        grad_slots_used=[0] * D,
+        act_live_peak=[b.live_peak for b in bufs],
+        grad_live_peak=[0] * D,
+        n_act_slots=n_slots, n_grad_slots=0,
+        comm=comm,
+        unit_counts={"F": len(fwd_done), "B": 0, "W": 0,
+                     "idle": int(T * D - len(fwd_done))},
+        compression={})
+
+
+def check_serving_ring(n_devices: int, n_slots: int) -> TableReport:
+    """Verify the serving executor's implicit round-robin slot schedule.
+
+    ``serving.engine`` has no tick table: at tick ``u`` device ``d`` serves
+    slot ``(u - d) % M`` and the scheduler banks last-stage output into
+    slot ``(u - D) % M``. The static invariants that make the +1 metadata
+    ring correct are checked over one full period:
+
+    - ``M >= D`` (a slot's state must clear the pipe before it returns);
+    - pipeline alignment: device ``d`` at tick ``u`` serves what device
+      ``d-1`` served at ``u-1`` (state arrives via one +1 ppermute hop);
+    - bank alignment: the banked slot at ``u`` is the slot device ``D-1``
+      served at ``u-1``;
+    - per device, each period serves every slot exactly once (permutation).
+    """
+    D, M = n_devices, n_slots
+    hazards: List[Hazard] = []
+    if M < D:
+        hazards.append(Hazard(
+            "ring-underfull", -1, -1, "n_slots",
+            f"n_slots={M} < pipe degree {D}: a slot would be re-admitted "
+            f"while its previous request is still in flight"))
+    else:
+        for u in range(M):
+            for d in range(1, D):
+                if (u - d) % M != ((u - 1) - (d - 1)) % M:
+                    hazards.append(Hazard(
+                        "ring-misaligned", d, u, "serve_slot",
+                        f"device {d} at tick {u} does not serve device "
+                        f"{d - 1}'s tick-{u - 1} slot"))
+            if (u - D) % M != ((u - 1) - (D - 1)) % M:
+                hazards.append(Hazard(
+                    "ring-misaligned", D - 1, u, "bank_slot",
+                    f"banked slot at tick {u} is not the last stage's "
+                    f"tick-{u - 1} output"))
+        for d in range(D):
+            served = {(u - d) % M for u in range(M)}
+            if served != set(range(M)):
+                hazards.append(Hazard(
+                    "ring-incomplete", d, -1, "serve_slot",
+                    f"device {d} serves {sorted(served)} per period, not "
+                    f"all {M} slots"))
+    return TableReport(
+        name="serving", kind="serving", n_devices=D, n_virtual=1,
+        n_microbatches=M, placement="wrap", split_backward=False,
+        makespan=M, hazards=hazards,
+        act_slots_used=[M] * D, grad_slots_used=[0] * D,
+        act_live_peak=[M] * D, grad_live_peak=[0] * D,
+        n_act_slots=M, n_grad_slots=0,
+        comm={"fwd_ring_pos": {"cells": M * D, "hop_ticks": M}},
+        unit_counts={"F": M * D, "B": 0, "W": 0, "idle": 0},
+        compression={})
+
+
+def static_analysis_section(reports: List[TableReport],
+                            verifier_version: int) -> Dict[str, object]:
+    """Assemble the ``RunReport`` manifest's ``static_analysis`` block
+    (see ``utils.telemetry.validate_report``) from verified tables."""
+    def label(r: TableReport) -> str:
+        return (f"{r.name}[D={r.n_devices},V={r.n_virtual},"
+                f"M={r.n_microbatches},{r.placement}]")
+
+    return {
+        "verifier_version": verifier_version,
+        "schedules": [label(r) for r in reports],
+        "hazards": sum(len(r.hazards) for r in reports),
+        "slot_high_water": {
+            label(r): {"act": max(r.act_slots_used, default=0),
+                       "grad": max(r.grad_slots_used, default=0)}
+            for r in reports},
+    }

@@ -84,8 +84,9 @@ def make_fsdp_grad_fn(cfg: ModelConfig, mesh: Mesh, params_template: Pytree,
     )
 
     def vg(params, tokens, targets):
-        return jax.value_and_grad(
-            lambda p: transformer_loss(cfg, p, tokens, targets))(params)
+        with jax.named_scope("fsdp/value_and_grad"):
+            return jax.value_and_grad(
+                lambda p: transformer_loss(cfg, p, tokens, targets))(params)
 
     # out_shardings pins grads to the param shards (reduce-scatter), which
     # XLA would otherwise be free to replicate

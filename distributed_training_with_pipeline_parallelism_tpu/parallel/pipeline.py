@@ -148,22 +148,31 @@ def _compile(name: str, D: int, V: int, M: int) -> CompiledSchedule:
     """Compile via the native C++ engine when available (bit-identical to the
     Python compiler — see tests/test_native_engine.py), else in Python.
     Custom registered schedules always compile in Python (their order
-    functions are Python)."""
+    functions are Python). With ``DTPP_VERIFY_TABLES`` set, the compiled
+    table additionally passes the static hazard verifier
+    (``analysis.table_check``) before it reaches the executor."""
+    from ..analysis import maybe_verify_schedule
     from . import native
     from .schedules import is_custom
     if is_custom(name) or name == "ZBV":
         # custom orders are Python functions; ZBV's order is synthesized by
         # a Python greedy simulation the C++ engine does not mirror
-        return compile_schedule(name, D, V, M)
+        cs = compile_schedule(name, D, V, M)
+        maybe_verify_schedule(cs)
+        return cs
+    cs = None
     if native.native_available():
         from .schedules import ScheduleError
         try:
-            return native.compile_schedule_native(name, D, V, M)
+            cs = native.compile_schedule_native(name, D, V, M)
         except ScheduleError:
             raise
         except Exception:
             pass  # fall through to the Python reference implementation
-    return compile_schedule(name, D, V, M)
+    if cs is None:
+        cs = compile_schedule(name, D, V, M)
+    maybe_verify_schedule(cs)
+    return cs
 
 
 # ---------------------------------------------------------------------------
@@ -1703,7 +1712,10 @@ def _fwd_tick_table(D: int, V: int, M: int):
     for d in range(D):
         for arrive, _, key in events[d]:
             table[arrive, d, 0] = slot_of[key]
-    return table, max(n_slots, 1)
+    n_slots = max(n_slots, 1)
+    from ..analysis import maybe_verify_forward_table
+    maybe_verify_forward_table(table, D, V, M, n_slots)
+    return table, n_slots
 
 
 def _build_forward_program(cfg: ModelConfig, mesh: Mesh,

@@ -399,9 +399,11 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
 
     @jax.jit
     def _gen(params, prompt, key_data):
-        stacked = stack_stage_layers(params["layers"], D, 1)
-        res = sharded(stacked, params["embed"], params["head"], prompt,
-                      key_data)
+        with jax.named_scope("decode/stack"):
+            stacked = stack_stage_layers(params["layers"], D, 1)
+        with jax.named_scope("decode/pipeline"):
+            res = sharded(stacked, params["embed"], params["head"], prompt,
+                          key_data)
         new = res[0] if eos_id is not None else res
         toks = jnp.concatenate([prompt, new.astype(prompt.dtype)], axis=1)
         if return_lengths:

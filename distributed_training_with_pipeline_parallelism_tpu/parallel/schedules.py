@@ -525,22 +525,40 @@ def validate_order(orders: List[List[Action]], n_devices: int, n_virtual: int,
                    placement: str = "wrap") -> None:
     """Structural validation: every (stage, microbatch) has exactly one F and
     one full B (or, under a split schedule, one W plus one dgrad B for every
-    stage except 0), F precedes B/W per device, and the tick scheduler
-    completes."""
+    stage except 0), F precedes B/W per device, W follows its dgrad twin
+    (whose saved slots it aliases), and the tick scheduler completes.
+    Error messages carry a (device, index) location prefix — the device and
+    per-device order position of the offending action."""
     S = n_devices * n_virtual
     seen: Dict[Action, int] = {}
     for d, order in enumerate(orders):
         pos = {}
         for i, a in enumerate(order):
             if a in seen:
-                raise ScheduleError(f"duplicate action {a}")
+                raise ScheduleError(
+                    f"(device {d}, index {i}): duplicate action {a} "
+                    f"(first listed on device {seen[a]})")
             seen[a] = d
             pos[a] = i
         for a in order:
             if a.op in (B, W):
                 fa = Action(a.stage, F, a.microbatch)
                 if fa not in pos or pos[fa] > pos[a]:
-                    raise ScheduleError(f"backward before forward: {a}")
+                    raise ScheduleError(
+                        f"(device {d}, index {pos[a]}): backward before "
+                        f"forward: {a}")
+            if a.op == W and a.stage >= 1:
+                # split-backward W reuses the dgrad B unit's saved slots
+                # (COL_W_ASLOT/COL_W_GSLOT alias COL_BWD_ASLOT/GSLOT, see
+                # analysis.table_check's w-slot-alias hazard) — so B(s, m)
+                # must precede W(s, m) in the same device order or the
+                # aliased slots would not exist yet. Stage 0 has no B; its
+                # W reads F(0, m)'s own slot.
+                ba = Action(a.stage, B, a.microbatch)
+                if ba not in pos or pos[ba] > pos[a]:
+                    raise ScheduleError(
+                        f"(device {d}, index {pos[a]}): {a} precedes its "
+                        f"dgrad twin {ba}, whose saved slots it aliases")
     want = {Action(s, F, m) for s in range(S) for m in range(n_microbatches)}
     if split_backward:
         want |= {Action(s, W, m) for s in range(S) for m in range(n_microbatches)}
@@ -808,21 +826,21 @@ def verify_table(cs: CompiledSchedule) -> None:
             row = cs.table[t, d]
             if row[COL_STORE_F_SLOT] >= 0:
                 if fwd_in[d] is None:
-                    raise ScheduleError(f"t={t} d={d}: fwd store of empty register")
+                    raise ScheduleError(f"(device {d}, tick {t}): fwd store of empty register")
                 act[d][int(row[COL_STORE_F_SLOT])] = fwd_in[d]
             if row[COL_STORE_F_NEG_SLOT] >= 0:
                 if fwd_in_neg[d] is None:
                     raise ScheduleError(
-                        f"t={t} d={d}: fwd-neg store of empty register")
+                        f"(device {d}, tick {t}): fwd-neg store of empty register")
                 act[d][int(row[COL_STORE_F_NEG_SLOT])] = fwd_in_neg[d]
             if row[COL_STORE_B_SLOT] >= 0:
                 if bwd_in[d] is None:
-                    raise ScheduleError(f"t={t} d={d}: bwd store of empty register")
+                    raise ScheduleError(f"(device {d}, tick {t}): bwd store of empty register")
                 grad[d][int(row[COL_STORE_B_SLOT])] = bwd_in[d]
             if row[COL_STORE_B_POS_SLOT] >= 0:
                 if bwd_in_pos[d] is None:
                     raise ScheduleError(
-                        f"t={t} d={d}: bwd-pos store of empty register")
+                        f"(device {d}, tick {t}): bwd-pos store of empty register")
                 grad[d][int(row[COL_STORE_B_POS_SLOT])] = bwd_in_pos[d]
             if row[COL_FWD_M] >= 0:
                 s = placement_stage_of(pl, d, int(row[COL_FWD_V]), D)
@@ -833,14 +851,14 @@ def verify_table(cs: CompiledSchedule) -> None:
                 got = act[d].get(slot)
                 if got != ("act", s, m):
                     raise ScheduleError(
-                        f"t={t} d={d}: F(stage={s}, mb={m}) read slot {slot} "
+                        f"(device {d}, tick {t}): F(stage={s}, mb={m}) read slot {slot} "
                         f"holding {got}")
                 if s < S - 1:
                     route = fwd_route(pl, s, D)
                     if route == "local":
                         if row[COL_FWD_LOCAL_SLOT] < 0:
                             raise ScheduleError(
-                                f"t={t} d={d}: F(stage={s}) local route "
+                                f"(device {d}, tick {t}): F(stage={s}) local route "
                                 f"without COL_FWD_LOCAL_SLOT")
                         act[d][int(row[COL_FWD_LOCAL_SLOT])] = ("act", s + 1, m)
                     elif route == "+1":
@@ -855,21 +873,21 @@ def verify_table(cs: CompiledSchedule) -> None:
                 got = act[d].get(aslot)
                 if got != ("act", s, m):
                     raise ScheduleError(
-                        f"t={t} d={d}: B(stage={s}, mb={m}) saved-input slot "
+                        f"(device {d}, tick {t}): B(stage={s}, mb={m}) saved-input slot "
                         f"{aslot} holds {got}")
                 if s < S - 1:
                     gslot = int(row[COL_BWD_GSLOT])
                     gg = grad[d].get(gslot)
                     if gg != ("gout", s, m):
                         raise ScheduleError(
-                            f"t={t} d={d}: B(stage={s}, mb={m}) grad slot "
+                            f"(device {d}, tick {t}): B(stage={s}, mb={m}) grad slot "
                             f"{gslot} holds {gg}")
                 if s > 0:
                     route = bwd_route(pl, s, D)
                     if route == "local":
                         if row[COL_BWD_LOCAL_SLOT] < 0:
                             raise ScheduleError(
-                                f"t={t} d={d}: B(stage={s}) local route "
+                                f"(device {d}, tick {t}): B(stage={s}) local route "
                                 f"without COL_BWD_LOCAL_SLOT")
                         grad[d][int(row[COL_BWD_LOCAL_SLOT])] = ("gout", s - 1, m)
                     elif route == "-1":
@@ -884,14 +902,14 @@ def verify_table(cs: CompiledSchedule) -> None:
                 got = act[d].get(aslot)
                 if got != ("act", s, m):
                     raise ScheduleError(
-                        f"t={t} d={d}: W(stage={s}, mb={m}) saved-input slot "
+                        f"(device {d}, tick {t}): W(stage={s}, mb={m}) saved-input slot "
                         f"{aslot} holds {got}")
                 if s < S - 1:
                     gslot = int(row[COL_W_GSLOT])
                     gg = grad[d].get(gslot)
                     if gg != ("gout", s, m):
                         raise ScheduleError(
-                            f"t={t} d={d}: W(stage={s}, mb={m}) grad slot "
+                            f"(device {d}, tick {t}): W(stage={s}, mb={m}) grad slot "
                             f"{gslot} holds {gg}")
                 w_done.add((s, m))
         fwd_in = [fwd_send[(d - 1) % D] for d in range(D)]

@@ -115,7 +115,8 @@ def make_tp_grad_fn(cfg: ModelConfig, mesh: Mesh,
     )
 
     def vg(params, tokens, targets):
-        return jax.value_and_grad(
-            lambda p: transformer_loss(cfg, p, tokens, targets))(params)
+        with jax.named_scope("tp/value_and_grad"):
+            return jax.value_and_grad(
+                lambda p: transformer_loss(cfg, p, tokens, targets))(params)
 
     return jax.jit(vg, in_shardings=in_sh)

@@ -5,10 +5,32 @@ block over the pipe mesh plus a host-side scheduler that admits, retires
 and refills per-slot requests between blocks (ISSUE 7 tentpole).
 :mod:`.bench` — the synthetic Poisson-trace benchmark comparing
 continuous vs static batching.
+
+Re-exports are lazy (same ``_LAZY``/``__getattr__`` pattern as the
+top-level package) so ``import ...serving`` does not pull in jax.
 """
 
-from .engine import (Completion, Request, ServeResult, ServingEngine,
-                     make_serving_step_fn)
+_LAZY = {
+    "Completion": ("engine", "Completion"),
+    "Request": ("engine", "Request"),
+    "ServeResult": ("engine", "ServeResult"),
+    "ServingEngine": ("engine", "ServingEngine"),
+    "make_serving_step_fn": ("engine", "make_serving_step_fn"),
+}
 
-__all__ = ["Completion", "Request", "ServeResult", "ServingEngine",
-           "make_serving_step_fn"]
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod, attr = _LAZY[name]
+        value = getattr(importlib.import_module(f".{mod}", __name__), attr)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))
+
+
+__all__ = sorted(_LAZY)

@@ -268,6 +268,8 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
     if M < D:
         raise ValueError(f"n_slots={M} must be >= the pipe degree {D} "
                          "(fewer slots than stages stalls the ring)")
+    from ..analysis import maybe_verify_serving
+    maybe_verify_serving(D, M)
     C = prefill_chunk
     if C < 1:
         raise ValueError(f"prefill_chunk must be >= 1, got {C}")

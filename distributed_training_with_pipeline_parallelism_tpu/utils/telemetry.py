@@ -346,6 +346,7 @@ class RunReport:
         self.telemetry: Optional[Dict[str, Any]] = None
         self.serving: List[Dict[str, Any]] = []
         self.resilience: Optional[Dict[str, Any]] = None
+        self.static_analysis: Optional[Dict[str, Any]] = None
         self.out_dir = out_dir
         self._events_fh = None
         # the event stream is written from the training loop AND from
@@ -409,6 +410,13 @@ class RunReport:
         and the guard counters) as the manifest's ``resilience`` block."""
         self.resilience = dict(section)
 
+    def attach_static_analysis(self, section: Dict[str, Any]) -> None:
+        """Embed the static-verification digest
+        (:func:`analysis.table_check.static_analysis_section`: verifier
+        version, schedules checked, hazard count, slot high-water marks)
+        as the manifest's ``static_analysis`` block."""
+        self.static_analysis = dict(section)
+
     # -- output ---------------------------------------------------------
 
     def manifest(self) -> Dict[str, Any]:
@@ -430,6 +438,8 @@ class RunReport:
             out["serving"] = _jsonable(self.serving)
         if self.resilience is not None:
             out["resilience"] = _jsonable(self.resilience)
+        if self.static_analysis is not None:
+            out["static_analysis"] = _jsonable(self.static_analysis)
         return out
 
     def write(self, path: Optional[str] = None) -> Dict[str, Any]:
@@ -553,3 +563,20 @@ def validate_report(manifest: Dict[str, Any]) -> None:
                 fail(f"resilience.{key} must be an int")
         if "preempted" in res and not isinstance(res["preempted"], bool):
             fail("resilience.preempted must be a bool")
+    sa = manifest.get("static_analysis")
+    if sa is not None:
+        if not isinstance(sa, dict):
+            fail("static_analysis must be a dict")
+        if not isinstance(sa.get("verifier_version"), int):
+            fail("static_analysis.verifier_version must be an int")
+        if not isinstance(sa.get("schedules"), list) or not all(
+                isinstance(s, str) for s in sa["schedules"]):
+            fail("static_analysis.schedules must be a list of strings")
+        if not isinstance(sa.get("hazards"), int):
+            fail("static_analysis.hazards must be an int")
+        shw = sa.get("slot_high_water")
+        if not isinstance(shw, dict) or not all(
+                isinstance(v, dict) and isinstance(v.get("act"), int)
+                and isinstance(v.get("grad"), int) for v in shw.values()):
+            fail("static_analysis.slot_high_water must map schedule labels "
+                 "to {'act': int, 'grad': int}")

@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Static-analysis gate: table verifier + repo lint (+ jaxpr audit).
+
+Thin wrapper over ``python -m
+distributed_training_with_pipeline_parallelism_tpu.analysis`` that first
+sets up the simulated 8-device CPU mesh (the jaxpr leg traces step
+functions over a 4-stage pipe mesh, and env must be set before the first
+jax import — same trick as tests/conftest.py). CI runs
+``scripts/check.py --all --json /tmp/check_report.json`` before pytest;
+see docs/static_analysis.md.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                           + os.environ.get("XLA_FLAGS", ""))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distributed_training_with_pipeline_parallelism_tpu.analysis.cli import (  # noqa: E402
+    main)
+
+if __name__ == "__main__":
+    sys.exit(main())

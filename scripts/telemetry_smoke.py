@@ -87,6 +87,20 @@ def main() -> int:
     report.count("steps", 1)
     report.event("smoke", phases=len(phases), ticks=int(cs.table.shape[0]))
     report.attach_telemetry(tel)
+    # the run's schedule also passes the static hazard verifier; its
+    # digest (verifier version, hazards=0, slot high-water marks) rides
+    # the manifest (docs/static_analysis.md)
+    from distributed_training_with_pipeline_parallelism_tpu.analysis import (
+        VERIFIER_VERSION)
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.table_check import (
+        check_table, static_analysis_section)
+    table_report = check_table(cs)
+    report.attach_static_analysis(
+        static_analysis_section([table_report], VERIFIER_VERSION))
+    if not table_report.ok:
+        print("telemetry_smoke: schedule table failed static verification",
+              file=sys.stderr)
+        return 1
     manifest = report.write()
     validate_report(manifest)  # write() validates too; belt and suspenders
     print(f"telemetry_smoke: OK — {len(phases)} phases over "

@@ -14,7 +14,18 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 BUDGET="${1:-870}"
-# Telemetry liveness first (own small budget, not charged to the suite's):
+# Static analysis first (own small budget, no jax execution): tick-table
+# hazard verifier over every registered schedule, repo lint, and the
+# jaxpr audit pinning traced step functions to the tables' predicted
+# collective counts. The JSON report lands in /tmp/check_report.json for
+# CI artifact upload (docs/static_analysis.md).
+if ! timeout -k 10 300 \
+    python scripts/check.py --all --json /tmp/check_report.json; then
+  echo "CHECK=fail"
+  exit 1
+fi
+echo "CHECK=ok"
+# Telemetry liveness next (own small budget, not charged to the suite's):
 # one instrumented pipeline step must produce a validated run report —
 # the observability layer's equivalent of "does it import". The report
 # lands in /tmp/telemetry_smoke for CI artifact upload.

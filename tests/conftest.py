@@ -15,6 +15,9 @@ os.environ["XLA_FLAGS"] = (
     "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", "")
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
+# every table the suite compiles also passes the static hazard verifier
+# (analysis.table_check) at build time
+os.environ.setdefault("DTPP_VERIFY_TABLES", "1")
 
 import jax  # noqa: E402
 

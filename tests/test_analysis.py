@@ -1,0 +1,555 @@
+"""Static-analysis tests: mutation testing of the table verifier, jaxpr
+audits pinned to the executor's traced collectives, repo-lint rules, and
+the RunReport ``static_analysis`` section.
+
+The mutation tests are the heart: each one corrupts exactly one cell of a
+known-good compiled table and asserts the verifier reports a hazard at the
+exact (device, tick, column) of the corruption — not merely "something is
+wrong". That is the property that makes the verifier usable as a schedule
+debugger (docs/static_analysis.md).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.analysis import (
+    maybe_verify_schedule, verify_tables_enabled)
+from distributed_training_with_pipeline_parallelism_tpu.analysis.cli import (
+    default_grid, run_table_checks)
+from distributed_training_with_pipeline_parallelism_tpu.analysis.jaxpr_audit import (
+    audit_fn)
+from distributed_training_with_pipeline_parallelism_tpu.analysis.repo_lint import (
+    lint_repo, lint_source)
+from distributed_training_with_pipeline_parallelism_tpu.analysis.table_check import (
+    check_forward_table, check_serving_ring, check_table,
+    static_analysis_section)
+from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules import (
+    COL_BWD_ASLOT, COL_BWD_GSLOT, COL_BWD_M, COL_BWD_V, COL_FWD_LOCAL_SLOT,
+    COL_FWD_M, COL_FWD_SLOT, COL_FWD_V, COL_STORE_F_SLOT, Action, B, F,
+    ScheduleError, W, compile_schedule, validate_order)
+
+
+def _mutated(cs, fn):
+    """Copy of ``cs`` with ``fn(table)`` applied to a writable table."""
+    table = np.array(cs.table, copy=True)
+    fn(table)
+    return dataclasses.replace(cs, table=table)
+
+
+def _has(report, kind, device, tick, column):
+    return any(h.kind == kind and h.device == device and h.tick == tick
+               and h.column == column for h in report.hazards)
+
+
+def _fail_msg(report, kind, device, tick, column):
+    return (f"expected {kind} at (device {device}, tick {tick}, {column}); "
+            f"got: {[str(h) for h in report.hazards]}")
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: every shipped schedule passes the verifier clean
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name,D,V,M", default_grid(),
+                         ids=lambda v: str(v))
+def test_shipped_schedules_verify_clean(name, D, V, M):
+    report = check_table(compile_schedule(name, D, V, M))
+    assert report.ok, [str(h) for h in report.hazards]
+    assert report.unit_counts["F"] == D * V * M
+    assert report.predicted_ppermutes > 0
+
+
+def test_run_table_checks_clean():
+    out = run_table_checks()
+    assert out["ok"] and out["n_hazards"] == 0
+    assert out["n_checked"] >= len(default_grid())
+
+
+# ---------------------------------------------------------------------------
+# mutation tests: one corrupted cell -> hazard at that exact location
+# ---------------------------------------------------------------------------
+
+
+def _find(table, pred):
+    """First (t, d) satisfying ``pred(row)``, scanning tick-major."""
+    for t in range(table.shape[0]):
+        for d in range(table.shape[1]):
+            if pred(table[t, d]):
+                return t, d
+    raise AssertionError("no matching cell in table")
+
+
+def test_mutation_swap_fwd_input_slot():
+    cs = compile_schedule("1F1B", 4, 1, 8)
+    # a stage > 0 forward (device > 0 under wrap, V=1): reads a banked
+    # slot, no in-place write
+    t, d = next((t, d) for t in range(cs.table.shape[0])
+                for d in range(1, 4)
+                if cs.table[t, d, COL_FWD_M] >= 0
+                and cs.table[t, d, COL_FWD_SLOT] >= 0)
+    slot = int(cs.table[t, d, COL_FWD_SLOT])
+    bad = _mutated(cs, lambda tb: tb.__setitem__(
+        (t, d, COL_FWD_SLOT), (slot + 1) % cs.n_act_slots))
+    rep = check_table(bad)
+    assert _has(rep, "read-wrong-value", d, t, "COL_FWD_SLOT"), \
+        _fail_msg(rep, "read-wrong-value", d, t, "COL_FWD_SLOT")
+
+
+def test_mutation_drop_store():
+    cs = compile_schedule("GPipe", 4, 1, 4)
+    t, d = _find(cs.table, lambda r: r[COL_STORE_F_SLOT] >= 0)
+    assert t >= 1  # fed by the ppermute at the end of tick t-1
+    bad = _mutated(cs, lambda tb: tb.__setitem__(
+        (t, d, COL_STORE_F_SLOT), -1))
+    rep = check_table(bad)
+    # the tick-(t-1) send now has no receiving store, located at the
+    # destination cell that should have banked it
+    assert _has(rep, "send-unpaired", d, t, "COL_STORE_F_SLOT"), \
+        _fail_msg(rep, "send-unpaired", d, t, "COL_STORE_F_SLOT")
+
+
+def test_mutation_spurious_store():
+    cs = compile_schedule("GPipe", 2, 1, 4)
+    assert cs.table[0, 0, COL_STORE_F_SLOT] < 0
+    bad = _mutated(cs, lambda tb: tb.__setitem__(
+        (0, 0, COL_STORE_F_SLOT), 0))
+    rep = check_table(bad)
+    assert _has(rep, "recv-unpaired", 0, 0, "COL_STORE_F_SLOT"), \
+        _fail_msg(rep, "recv-unpaired", 0, 0, "COL_STORE_F_SLOT")
+    assert _has(rep, "store-empty-register", 0, 0, "COL_STORE_F_SLOT")
+
+
+def test_mutation_spurious_local_route_on_wrap():
+    """Wrap placement rides the +1 ring; a set local-hop column is a
+    misroute even though the ring send itself is intact."""
+    cs = compile_schedule("1F1B", 4, 1, 4)
+    S = cs.n_stages
+    t, d = _find(cs.table, lambda r: r[COL_FWD_M] >= 0
+                 and int(r[COL_FWD_V]) * 4 + 0 <= S - 2)
+    bad = _mutated(cs, lambda tb: tb.__setitem__(
+        (t, d, COL_FWD_LOCAL_SLOT), 0))
+    rep = check_table(bad)
+    assert _has(rep, "route-mismatch", d, t, "COL_FWD_LOCAL_SLOT"), \
+        _fail_msg(rep, "route-mismatch", d, t, "COL_FWD_LOCAL_SLOT")
+
+
+def test_mutation_cleared_local_route_on_vshape():
+    """ZBV's turning point (stage D-1 -> D) is a same-device hop; clearing
+    COL_FWD_LOCAL_SLOT drops the handoff."""
+    cs = compile_schedule("ZBV", 2, 2, 4)
+    D = cs.n_devices
+    # stage D-1 lives on device D-1 under vshape placement, chunk 0
+    t, d = _find(cs.table, lambda r: r[COL_FWD_LOCAL_SLOT] >= 0)
+    bad = _mutated(cs, lambda tb: tb.__setitem__(
+        (t, d, COL_FWD_LOCAL_SLOT), -1))
+    rep = check_table(bad)
+    assert _has(rep, "route-mismatch", d, t, "COL_FWD_LOCAL_SLOT"), \
+        _fail_msg(rep, "route-mismatch", d, t, "COL_FWD_LOCAL_SLOT")
+
+
+def test_mutation_swap_bwd_saved_input_slot():
+    cs = compile_schedule("1F1B", 4, 1, 8)
+    t, d = _find(cs.table, lambda r: r[COL_BWD_M] >= 0)
+    aslot = int(cs.table[t, d, COL_BWD_ASLOT])
+    bad = _mutated(cs, lambda tb: tb.__setitem__(
+        (t, d, COL_BWD_ASLOT), (aslot + 1) % cs.n_act_slots))
+    rep = check_table(bad)
+    assert _has(rep, "read-wrong-value", d, t, "COL_BWD_ASLOT"), \
+        _fail_msg(rep, "read-wrong-value", d, t, "COL_BWD_ASLOT")
+
+
+def test_mutation_grad_slot_out_of_bounds():
+    cs = compile_schedule("1F1B", 4, 1, 8)
+    S = cs.n_stages
+    # a backward below the last stage reads an incoming cotangent slot
+    t, d = _find(cs.table, lambda r: r[COL_BWD_M] >= 0
+                 and int(r[COL_BWD_V]) * 4 + 0 < S - 1
+                 and r[COL_BWD_GSLOT] >= 0)
+    bad = _mutated(cs, lambda tb: tb.__setitem__(
+        (t, d, COL_BWD_GSLOT), cs.n_grad_slots))
+    rep = check_table(bad)
+    assert _has(rep, "slot-out-of-bounds", d, t, "COL_BWD_GSLOT"), \
+        _fail_msg(rep, "slot-out-of-bounds", d, t, "COL_BWD_GSLOT")
+
+
+def test_mutation_duplicate_microbatch():
+    cs = compile_schedule("GPipe", 2, 1, 4)
+    # device 0's second forward: rewrite its microbatch to repeat the first
+    hits = [(t, d) for t in range(cs.table.shape[0]) for d in (0,)
+            if cs.table[t, d, COL_FWD_M] >= 0]
+    (t0, _), (t1, d1) = hits[0], hits[1]
+    m0 = int(cs.table[t0, 0, COL_FWD_M])
+    bad = _mutated(cs, lambda tb: tb.__setitem__((t1, d1, COL_FWD_M), m0))
+    rep = check_table(bad)
+    assert _has(rep, "duplicate-unit", d1, t1, "COL_FWD_M"), \
+        _fail_msg(rep, "duplicate-unit", d1, t1, "COL_FWD_M")
+
+
+def test_mutation_w_slot_alias_broken():
+    """Split-backward W must read the B twin's saved slots — a W pointed at
+    a recycled slot is the ZB-H1 failure mode the verifier exists for."""
+    cs = compile_schedule("ZBH1", 2, 1, 4)
+    assert cs.split_backward
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules import (
+        COL_W_ASLOT, COL_W_M)
+    t, d = _find(cs.table, lambda r: r[COL_W_M] >= 0)
+    # device 1 hosts stage 1 (wrap): its W has a same-device B twin
+    t, d = _find(cs.table, lambda r: r[COL_W_M] >= 0) if d == 1 else (t, d)
+    for tt in range(cs.table.shape[0]):
+        if cs.table[tt, 1, COL_W_M] >= 0:
+            t, d = tt, 1
+            break
+    aslot = int(cs.table[t, d, COL_W_ASLOT])
+    bad = _mutated(cs, lambda tb: tb.__setitem__(
+        (t, d, COL_W_ASLOT), (aslot + 1) % max(cs.n_act_slots, 2)))
+    rep = check_table(bad)
+    assert _has(rep, "w-slot-alias", d, t, "COL_W_ASLOT"), \
+        _fail_msg(rep, "w-slot-alias", d, t, "COL_W_ASLOT")
+
+
+def test_mutation_war_store_redirect():
+    """Redirecting a store onto a slot whose previous value still has
+    pending reads is a WAR hazard at the store cell."""
+    cs = compile_schedule("GPipe", 2, 1, 4)
+    # device 1 banks one slot per microbatch; each stays live until its
+    # cooldown backward. Redirect the second store onto the first's slot.
+    stores = [(t, int(cs.table[t, 1, COL_STORE_F_SLOT]))
+              for t in range(cs.table.shape[0])
+              if cs.table[t, 1, COL_STORE_F_SLOT] >= 0]
+    (t0, s0), (t1, s1) = stores[0], stores[1]
+    assert s0 != s1
+    bad = _mutated(cs, lambda tb: tb.__setitem__(
+        (t1, 1, COL_STORE_F_SLOT), s0))
+    rep = check_table(bad)
+    assert _has(rep, "overwrite-live", 1, t1, "COL_STORE_F_SLOT"), \
+        _fail_msg(rep, "overwrite-live", 1, t1, "COL_STORE_F_SLOT")
+
+
+def test_mutation_cleared_backward_unit():
+    """Clearing a backward unit drops its cotangent send: the downstream
+    store one tick later on the -1 neighbour has no producer."""
+    cs = compile_schedule("1F1B", 4, 1, 4)
+    S = cs.n_stages
+    t, d = _find(cs.table, lambda r: r[COL_BWD_M] >= 0
+                 and int(r[COL_BWD_V]) * 4 + 2 > 0)
+    # pick a backward on device d > 0 so the send crosses the ring
+    for tt in range(cs.table.shape[0]):
+        for dd in range(1, 4):
+            if cs.table[tt, dd, COL_BWD_M] >= 0:
+                t, d = tt, dd
+                break
+        else:
+            continue
+        break
+
+    def clear(tb):
+        tb[t, d, COL_BWD_V] = -1
+        tb[t, d, COL_BWD_M] = -1
+        tb[t, d, COL_BWD_ASLOT] = -1
+        tb[t, d, COL_BWD_GSLOT] = -1
+
+    rep = check_table(_mutated(cs, clear))
+    dst = (d - 1) % 4
+    assert _has(rep, "recv-unpaired", dst, t + 1, "COL_STORE_B_SLOT"), \
+        _fail_msg(rep, "recv-unpaired", dst, t + 1, "COL_STORE_B_SLOT")
+    assert any(h.kind == "unit-count" for h in rep.hazards)
+
+
+def test_mutation_double_store_same_tick():
+    """Two writes into one act slot in one tick (+1-ring store and the
+    turning-point local hop both land on ZBV's device D-1) is a WAW
+    hazard at the second write's column."""
+    cs = compile_schedule("ZBV", 2, 2, 4)
+    hit = next((t, d, int(cs.table[t, d, COL_STORE_F_SLOT]))
+               for t in range(cs.table.shape[0])
+               for d in range(cs.n_devices)
+               if cs.table[t, d, COL_STORE_F_SLOT] >= 0
+               and cs.table[t, d, COL_FWD_LOCAL_SLOT] >= 0)
+    t, d, slot = hit
+    bad = _mutated(cs, lambda tb: tb.__setitem__(
+        (t, d, COL_FWD_LOCAL_SLOT), slot))
+    rep = check_table(bad)
+    assert _has(rep, "double-store", d, t, "COL_FWD_LOCAL_SLOT"), \
+        _fail_msg(rep, "double-store", d, t, "COL_FWD_LOCAL_SLOT")
+
+
+def test_mutation_forward_table_drop_store():
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        _fwd_tick_table)
+    table, n_slots = _fwd_tick_table(2, 1, 4)
+    t, d = _find(table, lambda r: r[0] >= 0)
+    bad = np.array(table, copy=True)
+    bad[t, d, 0] = -1
+    rep = check_forward_table(bad, 2, 1, 4, n_slots)
+    assert _has(rep, "send-unpaired", d, t, "STORE_SLOT"), \
+        _fail_msg(rep, "send-unpaired", d, t, "STORE_SLOT")
+
+
+# ---------------------------------------------------------------------------
+# comm volume + memory bound facts on clean tables
+# ---------------------------------------------------------------------------
+
+
+def test_report_slot_high_water_within_declared():
+    for name, D, V, M in (("GPipe", 4, 1, 8), ("1F1B", 4, 1, 8),
+                          ("ZBH1", 2, 1, 4), ("ZBV", 2, 2, 4)):
+        rep = check_table(compile_schedule(name, D, V, M))
+        assert max(rep.act_slots_used) <= rep.n_act_slots
+        assert max(rep.grad_slots_used) <= rep.n_grad_slots or \
+            rep.n_grad_slots == 0
+        assert all(p <= u for p, u in
+                   zip(rep.act_live_peak, rep.act_slots_used))
+
+
+def test_1f1b_memory_bound_beats_gpipe():
+    """The static activation bound reproduces 1F1B's O(in-flight) vs
+    GPipe's O(M) advantage — on the first device, 1F1B's high-water mark
+    must be strictly below GPipe's at M >> D."""
+    g = check_table(compile_schedule("GPipe", 4, 1, 8))
+    f = check_table(compile_schedule("1F1B", 4, 1, 8))
+    assert max(f.act_slots_used) < max(g.act_slots_used)
+
+
+def test_serving_ring_clean_and_underfull():
+    for D, M in ((2, 2), (4, 4), (4, 6)):
+        rep = check_serving_ring(D, M)
+        assert rep.ok, [str(h) for h in rep.hazards]
+    rep = check_serving_ring(4, 3)
+    assert any(h.kind == "ring-underfull" for h in rep.hazards)
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: validate_order extensions
+# ---------------------------------------------------------------------------
+
+
+def test_validate_order_w_before_dgrad_rejected():
+    # stage-1 W listed before its dgrad twin B on device 1
+    orders = [
+        [Action(0, F, 0), Action(0, W, 0)],
+        [Action(1, F, 0), Action(1, W, 0), Action(1, B, 0)],
+    ]
+    with pytest.raises(ScheduleError,
+                       match=r"\(device 1, index 1\).*precedes its dgrad"):
+        validate_order(orders, 2, 1, 1, split_backward=True)
+
+
+def test_validate_order_w_after_dgrad_accepted():
+    orders = [
+        [Action(0, F, 0), Action(0, W, 0)],
+        [Action(1, F, 0), Action(1, B, 0), Action(1, W, 0)],
+    ]
+    validate_order(orders, 2, 1, 1, split_backward=True)
+
+
+def test_validate_order_messages_carry_location():
+    dup = [
+        [Action(0, F, 0), Action(0, F, 0), Action(0, B, 0)],
+        [Action(1, F, 0), Action(1, B, 0)],
+    ]
+    with pytest.raises(ScheduleError, match=r"\(device 0, index 1\)"):
+        validate_order(dup, 2, 1, 1)
+    early_b = [
+        [Action(0, B, 0), Action(0, F, 0)],
+        [Action(1, F, 0), Action(1, B, 0)],
+    ]
+    with pytest.raises(ScheduleError, match=r"\(device 0, index 0\)"):
+        validate_order(early_b, 2, 1, 1)
+
+
+def test_verify_table_messages_carry_location():
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules import (
+        verify_table)
+    cs = compile_schedule("GPipe", 2, 1, 4)
+    t, d = _find(cs.table, lambda r: r[COL_STORE_F_SLOT] >= 0)
+    bad = _mutated(cs, lambda tb: tb.__setitem__(
+        (t, d, COL_STORE_F_SLOT), -1))
+    with pytest.raises(ScheduleError, match=r"\(device \d+, tick \d+\)"):
+        verify_table(bad)
+
+
+# ---------------------------------------------------------------------------
+# build-time hook (DTPP_VERIFY_TABLES)
+# ---------------------------------------------------------------------------
+
+
+def test_verify_tables_enabled_in_suite():
+    assert verify_tables_enabled()  # conftest sets DTPP_VERIFY_TABLES=1
+
+
+def test_maybe_verify_schedule_raises_on_corruption(monkeypatch):
+    cs = compile_schedule("GPipe", 2, 1, 4)
+    t, d = _find(cs.table, lambda r: r[COL_STORE_F_SLOT] >= 0)
+    bad = _mutated(cs, lambda tb: tb.__setitem__(
+        (t, d, COL_STORE_F_SLOT), -1))
+    monkeypatch.setenv("DTPP_VERIFY_TABLES", "1")
+    with pytest.raises(ScheduleError, match="static table verification"):
+        maybe_verify_schedule(bad)
+    monkeypatch.setenv("DTPP_VERIFY_TABLES", "0")
+    maybe_verify_schedule(bad)  # gate off: silent
+
+
+# ---------------------------------------------------------------------------
+# jaxpr audit: telemetry off => no callbacks; ppermutes == prediction
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name,V,M", [("GPipe", 1, 4), ("1F1B", 1, 4),
+                                      ("Interleaved1F1B", 2, 4)])
+def test_jaxpr_audit_pins_executor(name, V, M):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_training_with_pipeline_parallelism_tpu.models import (
+        transformer as tfm)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+        make_mesh)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        _compile, make_pipeline_step)
+
+    cfg = dtpp.ModelConfig(dim=16, n_layers=4 * V, n_heads=2, vocab_size=32,
+                           ffn_dim=32, max_seq_len=8)
+    mesh = make_mesh(n_pipe=4)
+    sched = dtpp.ScheduleConfig(name=name, n_microbatches=M, n_virtual=V)
+    step = make_pipeline_step(cfg, mesh, sched, unroll_ticks=True)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    tokens = jnp.zeros((M, 8), jnp.int32)
+    targets = jnp.zeros((M, 8), jnp.int32)
+
+    predicted = check_table(_compile(name, 4, V, M)).predicted_ppermutes
+    audit = audit_fn(step, params, tokens, targets,
+                     mesh_axes=tuple(mesh.axis_names),
+                     expect_no_callbacks=True,
+                     expected_ppermutes=predicted)
+    assert audit.ok, audit.problems
+    assert audit.n_callbacks == 0
+    assert audit.ppermute_count == predicted
+    assert not audit.unknown_axes
+    assert not audit.f64_values
+
+
+def test_jaxpr_audit_flags_callbacks():
+    import jax
+    import jax.numpy as jnp
+
+    def noisy(x):
+        jax.debug.print("x = {}", x)
+        return x * 2
+
+    audit = audit_fn(noisy, jnp.ones((2,)), expect_no_callbacks=True)
+    assert audit.n_callbacks > 0
+    assert not audit.ok
+
+
+def test_jaxpr_audit_flags_ppermute_mismatch():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return x + 1
+
+    audit = audit_fn(f, jnp.ones((2,)), expected_ppermutes=3)
+    assert not audit.ok
+    assert any("ppermute" in p for p in audit.problems)
+
+
+# ---------------------------------------------------------------------------
+# repo lint
+# ---------------------------------------------------------------------------
+
+
+def test_lint_repo_is_clean():
+    findings = lint_repo()
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_lint_flags_host_call_in_scan_body():
+    src = (
+        "import time\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "def tick(carry, x):\n"
+        "    t0 = time.time()\n"
+        "    y = np.asarray(x)\n"
+        "    z = y.item()\n"
+        "    return carry, x\n"
+        "jax.lax.scan(tick, 0, None, length=3)\n"
+    )
+    findings = lint_source("mod.py", src)
+    rules = [f.rule for f in findings]
+    assert rules.count("scan-body-host-call") == 3
+    assert {f.line for f in findings} == {5, 6, 7}
+
+
+def test_lint_ignores_host_call_outside_scan_body():
+    src = (
+        "import time\n"
+        "def setup():\n"
+        "    return time.time()\n"
+    )
+    assert lint_source("mod.py", src) == []
+
+
+def test_lint_flags_eager_init_import():
+    src = "from .engine import Thing\n"
+    findings = lint_source("pkg/__init__.py", src,
+                           package_relpath="serving/__init__.py")
+    assert [f.rule for f in findings] == ["init-lazy-exports"]
+    # the allowlisted config import stays legal
+    src_ok = "from .utils.config import ModelConfig\n"
+    assert lint_source("pkg/__init__.py", src_ok,
+                       package_relpath="__init__.py") == []
+
+
+def test_lint_flags_bare_jit_in_parallel():
+    src = "import jax\nf = jax.jit(lambda x: x)\n"
+    findings = lint_source("x.py", src, package_relpath="parallel/x.py")
+    assert [f.rule for f in findings] == ["jit-named-scope"]
+    # same file outside parallel/ is not in scope
+    assert lint_source("x.py", src, package_relpath="utils/x.py") == []
+    # a named scope anywhere in the module satisfies the rule
+    src_ok = ("import jax\n"
+              "def g(x):\n"
+              "    with jax.named_scope('phase'):\n"
+              "        return x\n"
+              "f = jax.jit(g)\n")
+    assert lint_source("x.py", src_ok, package_relpath="parallel/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# satellite 6: RunReport static_analysis section
+# ---------------------------------------------------------------------------
+
+
+def test_run_report_static_analysis_roundtrip(tmp_path):
+    from distributed_training_with_pipeline_parallelism_tpu.analysis import (
+        VERIFIER_VERSION)
+    from distributed_training_with_pipeline_parallelism_tpu.utils.telemetry import (
+        RunReport, validate_report)
+
+    reports = [check_table(compile_schedule("GPipe", 2, 1, 4)),
+               check_table(compile_schedule("1F1B", 2, 1, 4))]
+    section = static_analysis_section(reports, VERIFIER_VERSION)
+    assert section["hazards"] == 0
+    assert len(section["schedules"]) == 2
+
+    rr = RunReport("static-analysis-test")
+    rr.attach_static_analysis(section)
+    manifest = rr.manifest()
+    validate_report(manifest)  # schema-clean
+    assert manifest["static_analysis"]["verifier_version"] == VERIFIER_VERSION
+    labels = manifest["static_analysis"]["schedules"]
+    assert all("[D=2,V=1,M=4" in s for s in labels)
+    hw = manifest["static_analysis"]["slot_high_water"]
+    assert set(hw) == set(labels)
+    assert all(v["act"] >= 1 for v in hw.values())
+
+    # schema rejects a malformed section
+    bad = dict(manifest)
+    bad["static_analysis"] = dict(section, hazards="zero")
+    with pytest.raises(ValueError, match="static_analysis.hazards"):
+        validate_report(bad)
