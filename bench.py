@@ -85,7 +85,13 @@ def _init_backend(max_retries=None, backoff_s=None) -> dict:
     comes up the bench falls back to ``JAX_PLATFORMS=cpu``, recording
     ``{"backend_fallback": "cpu"}`` (plus the first error line) in the
     output — a degraded-but-honest run instead of a stack trace. Non-init
-    errors re-raise unchanged."""
+    errors re-raise unchanged.
+
+    ALL device discovery happens here, inside the guard: the returned
+    ``n_devices`` is what ``run``/``run_serve`` size the mesh with —
+    r05's second failure mode was a bare ``len(jax.devices())`` after
+    this function had already eaten the init error, re-raising outside
+    the guard."""
     if max_retries is None:
         max_retries = int(os.environ.get("BENCH_BACKEND_RETRIES", "3"))
     if backoff_s is None:
@@ -96,7 +102,9 @@ def _init_backend(max_retries=None, backoff_s=None) -> dict:
     for attempt in range(1, max(max_retries, 1) + 1):
         info["backend_attempts"] = attempt
         try:
-            info["backend"] = jax.devices()[0].platform
+            devices = jax.devices()
+            info["backend"] = devices[0].platform
+            info["n_devices"] = len(devices)
             return info
         except RuntimeError as e:
             msg = str(e)
@@ -117,7 +125,9 @@ def _init_backend(max_retries=None, backoff_s=None) -> dict:
         _jex_backend.clear_backends()
     except Exception:  # pragma: no cover - version-dependent internals
         pass
-    info["backend"] = jax.devices()[0].platform
+    devices = jax.devices()
+    info["backend"] = devices[0].platform
+    info["n_devices"] = len(devices)
     info["backend_fallback"] = "cpu"
     info["backend_error"] = (last_err or "").splitlines()[0][:300]
     return info
@@ -134,21 +144,12 @@ def chip_peak_flops() -> float:
 def train_flops_per_token(cfg, seq: int) -> float:
     """6*N + 12*L*dim*seq: fwd 2N + attention 2*2*L*dim*s per token (QK^T
     and PV each 2*dim*s per layer), bwd 2x fwd — the standard dense-LM
-    accounting (PaLM appendix B). Causal halves the live score matrix;
-    ref_decoder runs two unmasked attentions per layer (self + cross),
-    doubling it instead. N counts matmul-participating params only:
-    lookup-only embedding tables are excluded (a tied table IS the head
-    matmul, so it stays in)."""
-    shapes = jax.eval_shape(
-        lambda: tfm.transformer_init(jax.random.key(0), cfg))
-    n_params = sum(x.size for x in jax.tree.leaves(shapes))
-    if not cfg.tie_embeddings:
-        n_params -= shapes["embed"]["tok"].size  # lookup only, zero matmuls
-    if "pos" in shapes["embed"]:
-        n_params -= shapes["embed"]["pos"].size  # additive lookup
-    attn_fwd_per_tok = 2 * 2 * cfg.n_layers * cfg.dim * seq
-    attn_fwd_per_tok *= 2 if cfg.arch == "ref_decoder" else 0.5
-    return 6.0 * n_params + 3.0 * attn_fwd_per_tok
+    accounting (PaLM appendix B). The formula lives in
+    ``analysis.cost_model`` (the roofline needs the same numbers); this
+    delegate keeps bench's historical entry point."""
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.cost_model import (
+        train_flops_per_token as _train_flops_per_token)
+    return _train_flops_per_token(cfg, seq)
 
 
 def _time_step(step, params, tokens, targets, num_iterations):
@@ -183,8 +184,9 @@ def _time_step(step, params, tokens, targets, num_iterations):
 def run_config(cfg, batch_size, seq_length, num_iterations=20,
                schedule="GPipe", n_microbatches=4,
                force_tick_executor=False, remat_backward=None,
-               unroll_ticks=None) -> dict:
-    n_pipe = len(jax.devices())  # 1-D pipeline mesh over every visible chip
+               unroll_ticks=None, n_pipe=None) -> dict:
+    if n_pipe is None:  # 1-D pipeline mesh over every visible chip
+        n_pipe = len(jax.devices())
     sched = dtpp.ScheduleConfig(name=schedule, n_microbatches=n_microbatches)
     mesh = make_mesh(n_pipe=n_pipe)
     step = make_pipeline_step(cfg, mesh, sched,
@@ -213,6 +215,21 @@ def run_config(cfg, batch_size, seq_length, num_iterations=20,
     return row
 
 
+def _cost_model(cfg, batch_size, seq_length, n_pipe, headline,
+                num_iterations, n_microbatches=4) -> dict:
+    """Roofline section for the headline config (analysis.cost_model):
+    predicted vs measured step time, bubble fractions, MFU/HFU — attached
+    to the RunReport manifest and consumed by scripts/regress.py."""
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.cost_model import (
+        cost_model_section)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules import (
+        compile_schedule)
+    cs = compile_schedule("GPipe", n_pipe, 1, n_microbatches)
+    return cost_model_section(
+        cs, cfg, batch_size=batch_size, seq_length=seq_length,
+        measured_step_s=headline["elapsed_s"] / max(num_iterations, 1))
+
+
 def _result(headline, extra, n_pipe) -> dict:
     """Assemble the printed JSON line + the embedded RunReport manifest
     (same schema as sweep rows and ``fit`` reports — utils.telemetry)."""
@@ -225,8 +242,11 @@ def _result(headline, extra, n_pipe) -> dict:
                         "backend_error", "chip_peak_flops") if k in extra})
     for k, v in headline.items():
         report.gauge(f"headline_{k}", v)
+    cm = extra.get("cost_model")
+    if isinstance(cm, dict) and "schedule" in cm:  # not an error stub
+        report.attach_cost_model(cm)
     for key, row in extra.items():
-        if isinstance(row, dict):
+        if isinstance(row, dict) and key != "cost_model":
             report.event("rung", name=key, **row)
     manifest = report.manifest()
     validate_report(manifest)
@@ -256,7 +276,7 @@ def _result(headline, extra, n_pipe) -> dict:
 
 def run(num_iterations: int = 20) -> dict:
     backend = _init_backend()  # retry/backoff, then CPU fallback — never rc=1
-    n_pipe = len(jax.devices())
+    n_pipe = backend["n_devices"]  # discovered inside the guard above
     if "backend_fallback" in backend:
         # Accelerator never came up. The run now exists to prove liveness
         # and record the fallback, not to publish numbers: the real
@@ -266,8 +286,14 @@ def run(num_iterations: int = 20) -> dict:
         # a 2-iteration window, label it, and skip the model ladder.
         proxy_cfg = dtpp.ModelConfig(n_layers=4, max_seq_len=64)
         headline = run_config(proxy_cfg, 8, 64, min(num_iterations, 2),
-                              force_tick_executor=True)
-        extra = {"headline": headline, "n_devices": n_pipe, **backend,
+                              force_tick_executor=True, n_pipe=n_pipe)
+        try:
+            cost_model = _cost_model(proxy_cfg, 8, 64, n_pipe, headline,
+                                     min(num_iterations, 2))
+        except Exception as e:  # pragma: no cover - never blocks the row
+            cost_model = {"error": str(e)}
+        extra = {"headline": headline, "n_devices": n_pipe,
+                 "cost_model": cost_model, **backend,
                  "headline_proxy": "cpu fallback proxy: ref_decoder L4/H8 "
                                    "float32, batch 8, seq 64, 2 iterations "
                                    "— NOT comparable to the baseline",
@@ -286,14 +312,19 @@ def run(num_iterations: int = 20) -> dict:
     # backward, 4 microbatches) — the machinery this framework exists to
     # provide, not the degenerate fused path
     headline = run_config(ref_cfg, 32, 128, num_iterations,
-                          force_tick_executor=True)
+                          force_tick_executor=True, n_pipe=n_pipe)
     extra = {"headline": headline, "chip_peak_flops": chip_peak_flops(),
              "n_devices": n_pipe, **backend}
+    try:
+        extra["cost_model"] = _cost_model(ref_cfg, 32, 128, n_pipe,
+                                          headline, num_iterations)
+    except Exception as e:  # pragma: no cover - never blocks the headline
+        extra["cost_model"] = {"error": str(e)}
     # secondary configs are isolated: one config's failure (e.g. a device
     # count that does not divide a model's layer count) must not discard
     # the headline result — the reference's own sweep-error contract
     try:
-        fused = run_config(ref_cfg, 32, 128, num_iterations)
+        fused = run_config(ref_cfg, 32, 128, num_iterations, n_pipe=n_pipe)
         extra["fused_ceiling"] = fused
         extra["tick_executor_overhead"] = round(
             fused["tokens_per_sec"] / headline["tokens_per_sec"], 3)
@@ -301,7 +332,8 @@ def run(num_iterations: int = 20) -> dict:
         extra["fused_ceiling"] = {"error": str(e)}
     try:
         remat = run_config(ref_cfg, 32, 128, num_iterations,
-                           force_tick_executor=True, remat_backward=True)
+                           force_tick_executor=True, remat_backward=True,
+                           n_pipe=n_pipe)
         extra["tick_executor_remat"] = remat
         if n_pipe == 1:  # headline ran the unrolled stored form
             extra["stored_backward_speedup"] = round(
@@ -317,13 +349,13 @@ def run(num_iterations: int = 20) -> dict:
     try:
         extra["phase_executor"] = run_config(
             ref_cfg, 32, 128, num_iterations, force_tick_executor=True,
-            remat_backward=True, unroll_ticks="phases")
+            remat_backward=True, unroll_ticks="phases", n_pipe=n_pipe)
     except Exception as e:  # pragma: no cover - hardware-dependent
         extra["phase_executor"] = {"error": str(e)}
     try:
         extra["tick_executor_scan"] = run_config(
             ref_cfg, 32, 128, num_iterations, force_tick_executor=True,
-            remat_backward=True, unroll_ticks=False)
+            remat_backward=True, unroll_ticks=False, n_pipe=n_pipe)
     except Exception as e:  # pragma: no cover - hardware-dependent
         extra["tick_executor_scan"] = {"error": str(e)}
     # tie_embeddings=True is the real GPT-2 124M (and keeps the MFU's 6*N
@@ -376,7 +408,8 @@ def run(num_iterations: int = 20) -> dict:
         if rung_cfg.n_layers % n_pipe == 0:
             try:
                 extra[key] = run_config(rung_cfg, batch, seq,
-                                        num_iterations, n_microbatches=n_mb)
+                                        num_iterations, n_microbatches=n_mb,
+                                        n_pipe=n_pipe)
             except Exception as e:  # pragma: no cover - hardware-dependent
                 extra[key] = {"error": str(e)}
         else:
@@ -400,7 +433,7 @@ def run_serve() -> dict:
     from distributed_training_with_pipeline_parallelism_tpu.utils.telemetry import (
         RunReport, validate_report)
     backend = _init_backend()
-    if len(jax.devices()) < 2:
+    if backend["n_devices"] < 2:
         # single chip (or cpu): switch to the simulated-cpu mesh. The
         # host device count flag only takes effect if XLA_FLAGS carried
         # it before the FIRST backend init — ``__main__`` sets it for
@@ -413,8 +446,10 @@ def run_serve() -> dict:
             _jex_backend.clear_backends()
         except Exception:  # pragma: no cover - version-dependent internals
             pass
-        backend["backend"] = jax.devices()[0].platform
-    n_dev = len(jax.devices())
+        devices = jax.devices()
+        backend["backend"] = devices[0].platform
+        backend["n_devices"] = len(devices)
+    n_dev = backend["n_devices"]
     if backend["backend"] == "cpu":
         backend["serve_proxy"] = (f"{n_dev} simulated cpu devices — "
                                   "scheduling comparison only, NOT "

@@ -15,6 +15,12 @@ distributed_training_with_pipeline_parallelism_tpu.analysis``):
 - :mod:`.repo_lint` — ast rules: no host calls in tick/scan bodies,
   lazy-export discipline in ``__init__.py``, no bare ``jax.jit`` without
   a named scope in ``parallel/``.
+- :mod:`.cost_model` — analytical roofline accounting over compiled tick
+  tables (FLOPs per F/B/W unit, bytes per ring hop, predicted step time
+  under a :class:`~.cost_model.HardwareSpec`, table-exact/closed-form
+  bubble fractions, MFU/HFU from measured step time) — the predicted
+  side of the predicted↔measured loop ``utils.telemetry`` closes
+  (docs/observability.md "Cost model & MFU").
 
 The builders call the table passes at table-build time behind the
 ``DTPP_VERIFY_TABLES`` env flag (on in tests, off by default in
@@ -96,6 +102,16 @@ _LAZY = {
     "main": ("cli", "main"),
     "run_checks": ("cli", "run_checks"),
     "default_grid": ("cli", "default_grid"),
+    "HardwareSpec": ("cost_model", "HardwareSpec"),
+    "hardware_spec_for": ("cost_model", "hardware_spec_for"),
+    "detect_hardware": ("cost_model", "detect_hardware"),
+    "cost_model_section": ("cost_model", "cost_model_section"),
+    "serving_cost_model_section": ("cost_model",
+                                   "serving_cost_model_section"),
+    "train_flops_per_token": ("cost_model", "train_flops_per_token"),
+    "fwd_flops_per_token": ("cost_model", "fwd_flops_per_token"),
+    "resolve_backward_policy": ("cost_model", "resolve_backward_policy"),
+    "backward_weights": ("cost_model", "backward_weights"),
 }
 
 

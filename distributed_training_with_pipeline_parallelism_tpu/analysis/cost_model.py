@@ -1,0 +1,416 @@
+"""Analytical cost model: roofline accounting over the compiled tick table.
+
+The tick table already *is* the program (docs/schedules.md): every F/B/W
+unit and every ring hop a step will execute appears as a cell. This
+module prices those cells — FLOPs per unit from the model config, bytes
+per hop from the microbatch activation shape — against a
+:class:`HardwareSpec` roofline (peak dense FLOP/s + per-link ICI
+bandwidth) and produces the *predicted* side of the predicted↔measured
+loop that :mod:`..utils.telemetry` closes:
+
+- per-unit FLOPs (F, and B/W under the backward policy the executor
+  actually compiles: stored / remat / split — the same resolution
+  ``utils.sweep`` records as ``backward_policy``),
+- bytes moved per ring hop and total predicted ppermute hops (the
+  dead-hop-elided count from :class:`.table_check.TableReport`),
+- ideal step time under the roofline (serial and compute/comm-overlapped
+  bounds),
+- bubble fractions three ways: *table-exact* (idle cells over the
+  ``[T, D]`` grid, identical by construction to the static verifier's
+  ``unit_counts['idle'] / (T*D)``), *weighted* (per-tick lockstep
+  simulation under the backward-policy weights, equal to
+  ``schedules.simulated_bubble``), and *closed-form*
+  (``schedules.analytic_bubble_fraction``),
+- MFU/HFU once a measured step time is supplied (model FLOPs use the
+  standard ``6N + attention`` accounting; hardware FLOPs charge the
+  recompute the chosen backward policy actually executes).
+
+Everything here is host-side numpy over a handful of ``[T, D, 17]``
+tables — no jax execution (``jax.eval_shape`` only, for the parameter
+count). The output of :func:`cost_model_section` is a plain dict that
+rides the RunReport manifest (``attach_cost_model``; schema enforced by
+``utils.telemetry.validate_report``) and feeds
+``scripts/profile_breakdown.py`` and the ``scripts/regress.py``
+perf-regression sentinel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..parallel.schedules import (COL_STORE_B_POS_SLOT, COL_STORE_B_SLOT,
+                                  COL_STORE_F_NEG_SLOT, COL_STORE_F_SLOT,
+                                  CompiledSchedule, analytic_bubble_fraction,
+                                  table_unit_activity)
+
+__all__ = [
+    "HardwareSpec", "CPU_PROXY", "TPU_PRESETS", "hardware_spec_for",
+    "detect_hardware", "fwd_flops_per_token", "train_flops_per_token",
+    "resolve_backward_policy", "backward_weights", "dtype_bytes",
+    "cost_model_section", "serving_cost_model_section",
+]
+
+# The ring columns a hop can bank into, with the offset the sender sits
+# at: a store at (t, d) was ppermuted during tick t-1 by device
+# (d - offset) % D. Mirrors table_check.RING_CHANNELS (kept literal here
+# so the cost model never imports the verifier just for four constants).
+_STORE_CHANNELS = (
+    ("fwd_ring_pos", COL_STORE_F_SLOT, +1),
+    ("bwd_ring_neg", COL_STORE_B_SLOT, -1),
+    ("fwd_ring_neg", COL_STORE_F_NEG_SLOT, -1),
+    ("bwd_ring_pos", COL_STORE_B_POS_SLOT, +1),
+)
+
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "float64": 8}
+
+
+@dataclasses.dataclass(frozen=True)
+class HardwareSpec:
+    """Roofline parameters for one chip of the pipeline mesh.
+
+    ``peak_flops``: advertised dense bf16 peak per chip (the same numbers
+    ``bench.chip_peak_flops`` divides MFU by — kept equal by test).
+    ``ici_bytes_per_s``: usable unidirectional bandwidth of the one ICI
+    link a ring hop crosses. ``hbm_bytes_per_s``: per-chip HBM bandwidth
+    (the second roofline ceiling, reported for context). ``cpu_proxy``:
+    the numbers are order-of-magnitude placeholders for a simulated-CPU
+    host — predictions keep their *structure* (relative schedule ranking,
+    bubble fractions are hardware-free) but absolute seconds are not
+    accelerator claims, and downstream consumers (regress.py) treat the
+    run as warn-only."""
+
+    name: str
+    peak_flops: float
+    ici_bytes_per_s: float
+    hbm_bytes_per_s: float
+    cpu_proxy: bool = False
+
+    def summary(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# Peaks match bench._PEAK_FLOPS (v5e is 197 TFLOP/s bf16 — not its INT8
+# TOPS). ICI: one link of v4/v5e 3D/2D torus ~45-50 GB/s usable each
+# way; v5p ~100 GB/s; v6e ~90 GB/s. HBM: v5e 819 GB/s (the number
+# profile_breakdown.py's roofline uses), v4 1228, v5p 2765, v6e 1640.
+TPU_PRESETS: Dict[str, HardwareSpec] = {
+    "v5 lite": HardwareSpec("v5e", 197e12, 5.0e10, 8.19e11),
+    "v5e": HardwareSpec("v5e", 197e12, 5.0e10, 8.19e11),
+    "v5p": HardwareSpec("v5p", 459e12, 1.0e11, 2.765e12),
+    "v4": HardwareSpec("v4", 275e12, 5.0e10, 1.228e12),
+    "v6": HardwareSpec("v6e", 918e12, 9.0e10, 1.64e12),
+}
+
+# One host CPU core-ish matmul throughput and loopback "interconnect":
+# honest only about orders of magnitude, flagged cpu_proxy=True.
+CPU_PROXY = HardwareSpec("cpu_proxy", 5e10, 1e9, 5e10, cpu_proxy=True)
+
+
+def hardware_spec_for(device_kind: str) -> HardwareSpec:
+    """Map a ``device_kind``/platform string to a preset.
+
+    Substring match over the TPU presets (same rule as
+    ``bench.chip_peak_flops``); anything CPU-ish gets the labelled
+    :data:`CPU_PROXY`; an unrecognized accelerator defaults to the v5e
+    preset (the fleet default, matching bench's fallback)."""
+    kind = (device_kind or "").lower()
+    for key, spec in TPU_PRESETS.items():
+        if key in kind:
+            return spec
+    if "cpu" in kind or kind == "":
+        return CPU_PROXY
+    return TPU_PRESETS["v5e"]
+
+
+def detect_hardware() -> HardwareSpec:
+    """Spec for the first visible device; :data:`CPU_PROXY` when the
+    backend is CPU or unavailable."""
+    try:
+        import jax
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return CPU_PROXY
+        return hardware_spec_for(getattr(dev, "device_kind", "tpu"))
+    except Exception:
+        return CPU_PROXY
+
+
+def dtype_bytes(dtype: str) -> int:
+    return _DTYPE_BYTES.get(dtype, 4)
+
+
+def fwd_flops_per_token(cfg, seq: int) -> float:
+    """Forward FLOPs per token: ``2N + 4*L*dim*seq`` attention term.
+
+    ``N`` counts matmul-participating params only (lookup-only embedding
+    tables excluded; a tied table IS the head matmul so it stays in) via
+    ``jax.eval_shape`` — no arrays are materialized. Causal attention
+    halves the live score matrix; ``ref_decoder`` runs two unmasked
+    attentions per layer (self + cross), doubling it instead. This is the
+    canonical accounting: ``bench.train_flops_per_token`` is 3x this."""
+    import jax
+
+    from ..models import transformer as tfm
+    shapes = jax.eval_shape(
+        lambda: tfm.transformer_init(jax.random.key(0), cfg))
+    n_params = sum(x.size for x in jax.tree.leaves(shapes))
+    if not cfg.tie_embeddings:
+        n_params -= shapes["embed"]["tok"].size  # lookup only, zero matmuls
+    if "pos" in shapes["embed"]:
+        n_params -= shapes["embed"]["pos"].size  # additive lookup
+    attn_fwd_per_tok = 2 * 2 * cfg.n_layers * cfg.dim * seq
+    attn_fwd_per_tok *= 2 if cfg.arch == "ref_decoder" else 0.5
+    return 2.0 * n_params + attn_fwd_per_tok
+
+
+def train_flops_per_token(cfg, seq: int) -> float:
+    """``6N + 12*L*dim*seq``-family model FLOPs per trained token (fwd +
+    2x bwd — PaLM appendix B). The single source of truth bench delegates
+    to."""
+    return 3.0 * fwd_flops_per_token(cfg, seq)
+
+
+def resolve_backward_policy(cs: CompiledSchedule, remat_backward=None,
+                            n_devices: Optional[int] = None) -> str:
+    """Which backward the executor compiles for this schedule.
+
+    Mirrors ``make_pipeline_grad_fn``'s resolution (the rule
+    ``utils.sweep`` inlined until this module became the shared home):
+    split-backward schedules always rematerialize into separate
+    B (recompute + dgrad) and W (recompute + wgrad) units; otherwise
+    'stored' at D==1 by default or on explicit ``remat_backward=False``,
+    else 'remat'."""
+    if cs.split_backward:
+        return "split"
+    D = cs.n_devices if n_devices is None else n_devices
+    stored = remat_backward is False or (remat_backward is None and D == 1)
+    return "stored" if stored else "remat"
+
+
+def backward_weights(policy: str):
+    """Per-tick cost of (B, W) units in forward-unit equivalents.
+
+    stored: B = dgrad + wgrad ~ 2F, no W unit. remat: +1F recompute.
+    split: B = recompute + dgrad ~ 2F, W = recompute + wgrad ~ 2F."""
+    return {"stored": (2.0, 1.0), "remat": (3.0, 1.0),
+            "split": (2.0, 2.0)}[policy]
+
+
+def _hops_per_tick(table: np.ndarray) -> np.ndarray:
+    """Live ring hops launched at the end of each tick.
+
+    A store at ``(t, d, channel)`` banks data ppermuted during tick
+    ``t-1``, and one ppermute per channel serves every device that tick —
+    so hops[t-1] = number of channels with >= 1 store at t. Summed over
+    ticks this equals ``TableReport.predicted_ppermutes`` (channels with
+    zero cells contribute zero hop ticks)."""
+    T = table.shape[0]
+    hops = np.zeros(T, dtype=np.int64)
+    for t in range(1, T):
+        n_live = sum(1 for _, col, _ in _STORE_CHANNELS
+                     if (table[t, :, col] >= 0).any())
+        hops[t - 1] = n_live
+    return hops
+
+
+def cost_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
+                       seq_length: int,
+                       hardware: Optional[HardwareSpec] = None,
+                       remat_backward=None,
+                       measured_step_s: Optional[float] = None,
+                       telemetry=None,
+                       table_report=None) -> Dict[str, Any]:
+    """Price one compiled schedule against a roofline; reconcile with a
+    measured run when one is supplied.
+
+    ``telemetry``: a stamped :class:`..utils.telemetry.PipelineTelemetry`
+    — supplies ``measured_step_s`` (sum of timeline durations) when not
+    given explicitly, and adds the critical-path attribution table
+    (compute vs comm vs bubble seconds, straggler stage).
+    ``table_report``: a precomputed :class:`.table_check.TableReport`;
+    verified fresh via ``check_table`` when absent. Returns the plain
+    dict that ``RunReport.attach_cost_model`` embeds."""
+    table = cs.table
+    T, D = int(table.shape[0]), int(table.shape[1])
+    hw = hardware if hardware is not None else detect_hardware()
+    policy = resolve_backward_policy(cs, remat_backward)
+    w_b, w_w = backward_weights(policy)
+
+    # --- FLOPs per unit: one F unit = one microbatch through one stage
+    fwd_tok = fwd_flops_per_token(cfg, seq_length)
+    tokens_per_step = float(batch_size) * float(seq_length)
+    tokens_per_mb = tokens_per_step / cs.n_microbatches
+    unit_f = fwd_tok * tokens_per_mb / cs.n_stages
+    unit_b, unit_w = w_b * unit_f, w_w * unit_f
+    model_per_step = 3.0 * fwd_tok * tokens_per_step
+
+    activity = table_unit_activity(table)          # [T, D, (F,B,W,idle)]
+    counts = activity.sum(axis=(0, 1))             # cells per unit kind
+    # hardware FLOPs are table-exact: ZB variants elide stage-0 dgrad,
+    # remat recomputes — both show up in the cell counts / weights
+    hardware_per_step = (float(counts[0]) * unit_f
+                         + float(counts[1]) * unit_b
+                         + float(counts[2]) * unit_w)
+
+    # --- comm: activation slab one microbatch moves per ring hop
+    bytes_per_hop = (tokens_per_mb * cfg.dim * dtype_bytes(cfg.dtype))
+    if table_report is None:
+        from .table_check import check_table
+        table_report = check_table(cs)
+    hops_total = int(table_report.predicted_ppermutes)
+    hop_s = bytes_per_hop / hw.ici_bytes_per_s
+    hops_per_tick = _hops_per_tick(table)
+
+    # --- roofline: lockstep per-tick max across devices (the executor's
+    # actual synchronization model — every device waits for the tick's
+    # straggler), hops serialized after compute (serial bound) or
+    # overlapped with the launching tick (overlap bound)
+    unit_s = np.array([unit_f, unit_b, unit_w, 0.0]) / hw.peak_flops
+    per_dev_tick_s = activity.astype(np.float64) @ unit_s      # [T, D]
+    compute_tick_s = per_dev_tick_s.max(axis=1)                # [T]
+    t_compute_s = float(compute_tick_s.sum())
+    t_comm_s = float(hops_total) * hop_s
+    ideal_compute_s = hardware_per_step / (D * hw.peak_flops)
+    step_s_overlapped = float(
+        np.maximum(compute_tick_s, hops_per_tick * hop_s).sum())
+
+    # --- bubbles three ways (see module docstring)
+    idle_cells = int(counts[3])
+    bubble_table_exact = idle_cells / float(T * D)
+    bubble_weighted = (1.0 - ideal_compute_s / t_compute_s
+                       if t_compute_s > 0 else 0.0)
+    bubble_closed_form = float(analytic_bubble_fraction(
+        cs.name, D, cs.n_virtual, cs.n_microbatches, cs=cs))
+
+    section: Dict[str, Any] = {
+        "schedule": cs.name,
+        "n_devices": D,
+        "n_virtual": int(cs.n_virtual),
+        "n_microbatches": int(cs.n_microbatches),
+        "n_ticks": T,
+        "batch_size": int(batch_size),
+        "seq_length": int(seq_length),
+        "backward_policy": policy,
+        "hardware": hw.summary(),
+        "flops": {
+            "fwd_per_token": fwd_tok,
+            "train_per_token": 3.0 * fwd_tok,
+            "unit": {"F": unit_f, "B": unit_b, "W": unit_w},
+            "model_per_step": model_per_step,
+            "hardware_per_step": hardware_per_step,
+        },
+        "comm": {
+            "bytes_per_hop": float(bytes_per_hop),
+            "hops": hops_total,
+            "bytes_total": float(bytes_per_hop) * hops_total,
+        },
+        "predicted": {
+            "compute_s": t_compute_s,
+            "comm_s": t_comm_s,
+            "step_s": t_compute_s + t_comm_s,
+            "step_s_overlapped": step_s_overlapped,
+            "ideal_compute_s": ideal_compute_s,
+            "bubble_table_exact": bubble_table_exact,
+            "bubble_weighted": bubble_weighted,
+            "bubble_closed_form": bubble_closed_form,
+        },
+    }
+
+    if telemetry is not None and getattr(telemetry, "events", None):
+        if measured_step_s is None:
+            measured_step_s = sum((rec.get("duration_s") or 0.0)
+                                  for rec in telemetry.timeline())
+        from ..utils.telemetry import critical_path
+        cp = critical_path(telemetry)
+        section["attribution"] = {
+            k: cp[k] for k in ("compute_s", "comm_s", "bubble_s", "total_s",
+                               "n_ticks", "straggler_device",
+                               "straggler_stage", "straggler_s_per_device")}
+
+    if measured_step_s is not None and measured_step_s > 0:
+        chip_s = measured_step_s * D * hw.peak_flops
+        measured: Dict[str, Any] = {
+            "step_s": float(measured_step_s),
+            "tokens_per_sec": tokens_per_step / measured_step_s,
+            "mfu": model_per_step / chip_s,
+            "hfu": hardware_per_step / chip_s,
+            "predicted_over_measured":
+                section["predicted"]["step_s"] / measured_step_s,
+        }
+        if telemetry is not None and getattr(telemetry, "events", None):
+            sb = telemetry.stage_breakdown()
+            if "bubble_measured_mean" in sb:
+                measured["bubble_measured_mean"] = sb["bubble_measured_mean"]
+        section["measured"] = measured
+
+    return section
+
+
+def serving_cost_model_section(cfg, n_pipe: int, n_slots: int,
+                               summary: Dict[str, Any],
+                               hardware: Optional[HardwareSpec] = None,
+                               ) -> Dict[str, Any]:
+    """Cost-model section for a serving run (same manifest schema).
+
+    A decode tick moves one token-slot through each stage and rolls the
+    ring once; predicted per-tick time is the roofline on one token's
+    stage slice plus one hop of a ``dim``-wide activation row. Measured
+    MFU uses forward FLOPs only (decoding trains nothing). ``summary``:
+    a ``serving_summary`` dict (ticks, wall_s, tokens_out...)."""
+    hw = hardware if hardware is not None else detect_hardware()
+    seq = cfg.max_seq_len
+    fwd_tok = fwd_flops_per_token(cfg, seq)
+    bytes_per_hop = float(cfg.dim * dtype_bytes(cfg.dtype))
+    per_tick_compute_s = fwd_tok / n_pipe / hw.peak_flops
+    hop_s = bytes_per_hop / hw.ici_bytes_per_s
+    ticks = int(summary.get("ticks") or 0)
+    wall_s = float(summary.get("wall_s") or 0.0)
+    tokens_out = int(summary.get("tokens_out") or 0)
+    section: Dict[str, Any] = {
+        "schedule": "serving_ring",
+        "n_devices": int(n_pipe),
+        "n_virtual": 1,
+        "n_microbatches": int(n_slots),
+        "n_ticks": ticks,
+        "batch_size": int(n_slots),
+        "seq_length": int(seq),
+        "backward_policy": "none",
+        "hardware": hw.summary(),
+        "flops": {
+            "fwd_per_token": fwd_tok,
+            "train_per_token": 0.0,
+            "unit": {"F": fwd_tok / n_pipe, "B": 0.0, "W": 0.0},
+            "model_per_step": fwd_tok,        # per decoded token
+            "hardware_per_step": fwd_tok,
+        },
+        "comm": {
+            "bytes_per_hop": bytes_per_hop,
+            # the ring rolls every tick regardless of slot occupancy
+            "hops": ticks,
+            "bytes_total": bytes_per_hop * ticks,
+        },
+        "predicted": {
+            "compute_s": per_tick_compute_s,
+            "comm_s": hop_s,
+            "step_s": per_tick_compute_s + hop_s,   # per tick
+            "step_s_overlapped": max(per_tick_compute_s, hop_s),
+            "ideal_compute_s": per_tick_compute_s,
+            "bubble_table_exact": 0.0,
+            "bubble_weighted": 0.0,
+            "bubble_closed_form": 0.0,
+        },
+    }
+    if ticks > 0 and wall_s > 0:
+        chip_s = wall_s * n_pipe * hw.peak_flops
+        section["measured"] = {
+            "step_s": wall_s / ticks,                # per tick
+            "tokens_per_sec": tokens_out / wall_s,
+            "mfu": tokens_out * fwd_tok / chip_s,
+            "hfu": tokens_out * fwd_tok / chip_s,
+            "predicted_over_measured":
+                section["predicted"]["step_s"] / (wall_s / ticks),
+        }
+    return section

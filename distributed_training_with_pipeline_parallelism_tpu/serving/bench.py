@@ -110,6 +110,18 @@ def run_serve_bench(*, cfg: Optional[ModelConfig] = None, params=None,
         if report is not None:
             report.attach_serving(serving_summary(res))
 
+    if report is not None:
+        # roofline per decode tick for the continuous run (same manifest
+        # section as training: predicted vs measured tick time, serving
+        # MFU from forward FLOPs/token — analysis.cost_model)
+        try:
+            from ..analysis.cost_model import serving_cost_model_section
+            report.attach_cost_model(serving_cost_model_section(
+                cfg, int(mesh.shape["pipe"]), n_slots,
+                serving_summary(results["continuous"])))
+        except Exception:  # pragma: no cover - accounting never fails a run
+            pass
+
     cont, stat = results["continuous"], results["static"]
     # same program + greedy: both policies must emit identical tokens per
     # request — anything else is a scheduler bug, not a perf difference

@@ -660,6 +660,15 @@ class ServingEngine:
             raise RuntimeError(f"serving did not drain within {max_blocks} "
                                "blocks — check arrivals/budgets")
         wall = time.perf_counter() - wall0
-        return ServeResult(completions=self.completions,
-                           occupancy=self.occupancy, ticks=self._tick,
-                           wall_s=wall, n_slots=p.n_slots, policy=policy)
+        result = ServeResult(completions=self.completions,
+                             occupancy=self.occupancy, ticks=self._tick,
+                             wall_s=wall, n_slots=p.n_slots, policy=policy)
+        if self.report is not None:
+            # one event per run with the measured tick rate — the factor
+            # the cost model's predicted per-tick time reconciles against
+            self.report.event(
+                "serve_run", policy=policy, ticks=result.ticks,
+                wall_s=round(wall, 4), tokens_out=result.tokens_out,
+                s_per_tick=(round(wall / result.ticks, 6)
+                            if result.ticks else None))
+        return result

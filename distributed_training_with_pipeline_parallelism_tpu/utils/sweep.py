@@ -55,7 +55,8 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
 
     Self-describing columns (so the artifact cannot be misread without its
     docs): ``backward_policy`` records which backward the executor compiled
-    ('stored' or 'remat'), ``tick_executor`` which tick-loop formulation
+    ('stored', 'remat' or 'split' — ``analysis.cost_model``'s shared
+    resolution), ``tick_executor`` which tick-loop formulation
     ('unrolled', 'scan', or 'phases' — the ``unroll_ticks`` resolution),
     ``bubble_sim_w_b`` the matching per-tick backward
     weight the ``bubble_simulated`` column was computed under, and
@@ -108,18 +109,25 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
         cs = compile_schedule(schedule_type, num_devices, n_virtual,
                               n_microbatches)
         # bubble_simulated uses the weights of the backward the executor
-        # actually compiled, mirroring make_pipeline_grad_fn's resolution:
-        # stored (w_b=2, ~2 fwd-equivalents of grad work) at D==1 by
-        # default or on explicit remat_backward=False; otherwise remat
-        # (w_b=3: +1 recompute). Split-backward schedules always
-        # rematerialize: B = recompute + dgrad ~ 2, W = recompute +
-        # wgrad ~ 2.
-        stored = not cs.split_backward and (
-            remat_backward is False
-            or (remat_backward is None and num_devices == 1))
-        w_b, w_w = (2.0, 1.0) if stored else (
-            (3.0, 1.0) if not cs.split_backward else (2.0, 2.0))
+        # actually compiled, mirroring make_pipeline_grad_fn's resolution
+        # (shared with the roofline in analysis.cost_model): stored
+        # (w_b=2, ~2 fwd-equivalents of grad work) at D==1 by default or
+        # on explicit remat_backward=False; otherwise remat (w_b=3: +1
+        # recompute). Split-backward schedules always rematerialize:
+        # B = recompute + dgrad ~ 2, W = recompute + wgrad ~ 2.
+        from ..analysis.cost_model import (backward_weights,
+                                           cost_model_section,
+                                           resolve_backward_policy)
+        policy = resolve_backward_policy(cs, remat_backward, num_devices)
+        w_b, w_w = backward_weights(policy)
         sim = simulated_bubble(cs, w_f=1.0, w_b=w_b, w_w=w_w)
+        # the full roofline section (predicted vs measured step time,
+        # table-exact bubble, MFU) — its headline numbers also land as
+        # sweep columns so schedule comparisons stay one-DataFrame reads
+        cost_model = cost_model_section(
+            cs, cfg, batch_size=batch_size, seq_length=seq_length,
+            remat_backward=remat_backward,
+            measured_step_s=metrics["elapsed_time"] / num_iterations)
         metrics.update({
             "throughput_per_chip": metrics["throughput"] / num_devices,
             "n_virtual": n_virtual,
@@ -128,7 +136,10 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                 schedule_type, num_devices, n_virtual, n_microbatches, cs=cs),
             "bubble_simulated": sim["bubble_fraction"],
             "bubble_sim_w_b": w_b,
-            "backward_policy": "stored" if stored else "remat",
+            "bubble_table_exact": cost_model["predicted"][
+                "bubble_table_exact"],
+            "mfu": cost_model.get("measured", {}).get("mfu"),
+            "backward_policy": policy,
             # which tick-loop formulation compiled (mirrors the auto
             # resolution in make_pipeline_grad_fn; 'unrolled' also covers
             # the D==1 stored program, which is unrolled by construction)
@@ -145,6 +156,7 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
             from .telemetry import validate_report
             for k, v in metrics.items():
                 report.gauge(k, v)
+            report.attach_cost_model(cost_model)
             manifest = report.manifest()
             validate_report(manifest)
             os.makedirs(report_dir, exist_ok=True)

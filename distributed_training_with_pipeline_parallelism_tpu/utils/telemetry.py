@@ -27,6 +27,20 @@ Two pieces:
   ``fit`` and ``bench.py`` all emit the same schema instead of ad-hoc
   dicts.
 
+Two consumers of the stamps beyond the tabular breakdown:
+
+- :func:`perfetto_trace` / :func:`write_perfetto_trace` — the measured
+  timeline serialized as Chrome-trace JSON (one track per device, one
+  complete "X" slice per F/B/W/idle cell, flow arrows for every ring-hop
+  store), loadable in ui.perfetto.dev or chrome://tracing
+  (docs/observability.md "Opening traces in Perfetto").
+
+- :func:`critical_path` — walks the measured ticks and attributes each
+  to compute (naming the straggler device under the per-tick lockstep
+  model) vs comm (a ring hop in flight, nothing computing) vs bubble
+  (nothing at all) — the attribution table the ``cost_model`` manifest
+  section embeds (``analysis.cost_model``).
+
 Stamp semantics under SPMD: ``io_callback`` inside ``shard_map`` fires
 once **per device** (a 4-device mesh emits 4 stamps per logical event), so
 every analysis groups events by ``(kind, index)`` and takes ``min`` of
@@ -261,6 +275,196 @@ class PipelineTelemetry:
 
 
 # ---------------------------------------------------------------------------
+# Trace export + critical-path attribution
+# ---------------------------------------------------------------------------
+
+
+def _tick_times(telemetry: PipelineTelemetry):
+    """Per-tick ``(t0, duration)`` seconds, relative to the first stamp.
+
+    Segment durations are spread uniformly over the segment's ticks (the
+    same attribution model as :meth:`PipelineTelemetry.stage_breakdown`:
+    inside one fused scan per-tick variation is not observable). Phase and
+    scan segments carry absolute ``t0``/``t1`` stamps; unrolled records
+    only a ``t1`` per tick, so starts chain from the previous boundary."""
+    if telemetry.table is None:
+        raise ValueError("no tick table attached")
+    T = int(telemetry.table.shape[0])
+    t0 = np.zeros(T)
+    dur = np.zeros(T)
+    origin = None
+    cursor = 0.0
+    for rec in telemetry.timeline():
+        start, n = rec["start_tick"], rec["n_ticks"]
+        d = rec.get("duration_s") or 0.0
+        per = d / n if n else 0.0
+        if rec.get("t0") is not None:
+            if origin is None:
+                origin = rec["t0"]
+            base = rec["t0"] - origin
+        elif rec.get("t1") is not None:
+            if origin is None:
+                origin = rec["t1"] - d
+            base = rec["t1"] - origin - d
+        else:
+            base = cursor
+        for k in range(n):
+            if start + k < T:
+                t0[start + k] = base + k * per
+                dur[start + k] = per
+        cursor = base + n * per
+    return t0, dur
+
+
+def _store_channels():
+    """(name, store column, sender offset) per ring direction: a store at
+    ``(t, d, col)`` banks data ppermuted during tick ``t-1`` by device
+    ``(d - offset) % D`` (same convention as
+    ``analysis.table_check.RING_CHANNELS``)."""
+    from ..parallel.schedules import (COL_STORE_B_POS_SLOT, COL_STORE_B_SLOT,
+                                      COL_STORE_F_NEG_SLOT, COL_STORE_F_SLOT)
+    return (("fwd_ring_pos", COL_STORE_F_SLOT, +1),
+            ("bwd_ring_neg", COL_STORE_B_SLOT, -1),
+            ("fwd_ring_neg", COL_STORE_F_NEG_SLOT, -1),
+            ("bwd_ring_pos", COL_STORE_B_POS_SLOT, +1))
+
+
+def critical_path(telemetry: PipelineTelemetry) -> Dict[str, Any]:
+    """Attribute each measured tick to compute vs comm vs bubble.
+
+    Under the executor's lockstep model every device waits for the tick's
+    straggler, so a tick is *compute* when any device runs a unit (the
+    straggler = the device with the heaviest weighted work that tick,
+    F=1/B=2/W=1), *comm* when nothing computes but a ring hop is in
+    flight (some channel banks a store next tick), and *bubble* when the
+    tick does neither. Returns aggregate seconds, the per-tick
+    classification, and per-device straggler time — "which stage is the
+    step waiting on" as a number."""
+    from ..parallel.schedules import table_unit_activity
+    if telemetry.table is None:
+        raise ValueError("no tick table attached")
+    table = telemetry.table
+    T, D = int(table.shape[0]), int(table.shape[1])
+    activity = table_unit_activity(table)  # [T, D, 4]
+    t0, dur = _tick_times(telemetry)
+    weights = np.array([1.0, 2.0, 1.0, 0.0])
+    work = activity.astype(np.float64) @ weights  # [T, D]
+    store_cols = [col for _, col, _ in _store_channels()]
+    agg = {"compute": 0.0, "comm": 0.0, "bubble": 0.0}
+    straggler_s = np.zeros(D)
+    per_tick: List[Dict[str, Any]] = []
+    for t in range(T):
+        hop_in_flight = (t + 1 < T
+                         and bool((table[t + 1][:, store_cols] >= 0).any()))
+        if work[t].max() > 0:
+            cls = "compute"
+            straggler = int(work[t].argmax())
+            straggler_s[straggler] += dur[t]
+        elif hop_in_flight:
+            cls, straggler = "comm", None
+        else:
+            cls, straggler = "bubble", None
+        agg[cls] += dur[t]
+        per_tick.append({"tick": t, "class": cls, "straggler": straggler,
+                         "duration_s": float(dur[t])})
+    sd = int(straggler_s.argmax())
+    return {
+        "n_ticks": T,
+        "total_s": float(dur.sum()),
+        "compute_s": float(agg["compute"]),
+        "comm_s": float(agg["comm"]),
+        "bubble_s": float(agg["bubble"]),
+        "straggler_s_per_device": [float(x) for x in straggler_s],
+        "straggler_device": sd,
+        "straggler_stage": f"device {sd}",
+        "per_tick": per_tick,
+    }
+
+
+def perfetto_trace(telemetry: PipelineTelemetry) -> Dict[str, Any]:
+    """The measured timeline as a Chrome-trace/Perfetto JSON object.
+
+    One track (tid) per pipeline device under a single process, one
+    complete ``"X"`` slice per (tick, device) unit — named ``F m3`` /
+    ``B v1 m2`` / ``W m0`` / ``idle``, categorized by kind — and one
+    ``"s"``→``"f"`` flow pair per ring-hop store (cat ``ppermute``,
+    anchored mid-slice on the sending and receiving ticks) so arrows in
+    the UI show exactly the hops the table predicts. Timestamps are
+    microseconds from the first stamp, sorted ascending; load the written
+    file in ui.perfetto.dev or chrome://tracing."""
+    from ..parallel.schedules import (COL_BWD_M, COL_BWD_V, COL_FWD_M,
+                                      COL_FWD_V, COL_W_M, COL_W_V)
+    if telemetry.table is None:
+        raise ValueError("no tick table attached")
+    table = telemetry.table
+    T, D = int(table.shape[0]), int(table.shape[1])
+    n_virtual = max(1, (int(table[..., (COL_FWD_V, COL_BWD_V, COL_W_V),
+                                ].max()) + 1))
+    t0, dur = _tick_times(telemetry)
+    us = 1e6
+    events: List[Dict[str, Any]] = [{
+        "ph": "M", "name": "process_name", "pid": 0, "tid": 0, "ts": 0.0,
+        "args": {"name": f"pipeline ({telemetry.executor})"},
+    }]
+    for d in range(D):
+        events.append({"ph": "M", "name": "thread_name", "pid": 0, "tid": d,
+                       "ts": 0.0, "args": {"name": f"device {d}"}})
+    units = ((COL_FWD_V, COL_FWD_M, "F"), (COL_BWD_V, COL_BWD_M, "B"),
+             (COL_W_V, COL_W_M, "W"))
+    for t in range(T):
+        ts, width = t0[t] * us, dur[t] * us
+        for d in range(D):
+            row = table[t, d]
+            active = 0
+            for col_v, col_m, kind in units:
+                if row[col_m] >= 0:
+                    active += 1
+                    v, m = int(row[col_v]), int(row[col_m])
+                    name = (f"{kind} v{v} m{m}" if n_virtual > 1
+                            else f"{kind} m{m}")
+                    events.append({
+                        "ph": "X", "name": name, "cat": kind, "pid": 0,
+                        "tid": d, "ts": ts, "dur": width,
+                        "args": {"tick": t, "v": v, "m": m}})
+            if active == 0:
+                events.append({"ph": "X", "name": "idle", "cat": "idle",
+                               "pid": 0, "tid": d, "ts": ts, "dur": width,
+                               "args": {"tick": t}})
+    flow_id = 0
+    for t in range(1, T):
+        for name, col, offset in _store_channels():
+            for d in range(D):
+                if table[t, d, col] >= 0:
+                    flow_id += 1
+                    sender = (d - offset) % D
+                    events.append({
+                        "ph": "s", "id": flow_id, "name": name,
+                        "cat": "ppermute", "pid": 0, "tid": sender,
+                        "ts": (t0[t - 1] + 0.5 * dur[t - 1]) * us})
+                    events.append({
+                        "ph": "f", "bp": "e", "id": flow_id, "name": name,
+                        "cat": "ppermute", "pid": 0, "tid": d,
+                        "ts": (t0[t] + 0.5 * dur[t]) * us})
+    # sorted ts is part of the format contract (and what the schema test
+    # pins); metadata first among equals so track names land before slices
+    events.sort(key=lambda e: (e["ts"], 0 if e["ph"] == "M" else 1))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"executor": telemetry.executor, "n_devices": D,
+                      "n_ticks": T, "n_flows": flow_id},
+    }
+
+
+def write_perfetto_trace(telemetry: PipelineTelemetry, path: str) -> str:
+    """Serialize :func:`perfetto_trace` to ``path``; returns the path."""
+    trace = perfetto_trace(telemetry)
+    with open(path, "w") as fh:
+        json.dump(trace, fh)
+    return path
+
+
+# ---------------------------------------------------------------------------
 # Serving latency summaries
 # ---------------------------------------------------------------------------
 
@@ -347,6 +551,7 @@ class RunReport:
         self.serving: List[Dict[str, Any]] = []
         self.resilience: Optional[Dict[str, Any]] = None
         self.static_analysis: Optional[Dict[str, Any]] = None
+        self.cost_model: Optional[Dict[str, Any]] = None
         self.out_dir = out_dir
         self._events_fh = None
         # the event stream is written from the training loop AND from
@@ -417,6 +622,14 @@ class RunReport:
         as the manifest's ``static_analysis`` block."""
         self.static_analysis = dict(section)
 
+    def attach_cost_model(self, section: Dict[str, Any]) -> None:
+        """Embed the roofline accounting
+        (:func:`analysis.cost_model.cost_model_section`: predicted vs
+        measured step time, bubble fractions, ppermute hops, MFU/HFU,
+        critical-path attribution) as the manifest's ``cost_model``
+        block — the record ``scripts/regress.py`` reads."""
+        self.cost_model = dict(section)
+
     # -- output ---------------------------------------------------------
 
     def manifest(self) -> Dict[str, Any]:
@@ -440,6 +653,8 @@ class RunReport:
             out["resilience"] = _jsonable(self.resilience)
         if self.static_analysis is not None:
             out["static_analysis"] = _jsonable(self.static_analysis)
+        if self.cost_model is not None:
+            out["cost_model"] = _jsonable(self.cost_model)
         return out
 
     def write(self, path: Optional[str] = None) -> Dict[str, Any]:
@@ -580,3 +795,39 @@ def validate_report(manifest: Dict[str, Any]) -> None:
                 and isinstance(v.get("grad"), int) for v in shw.values()):
             fail("static_analysis.slot_high_water must map schedule labels "
                  "to {'act': int, 'grad': int}")
+    cm = manifest.get("cost_model")
+    if cm is not None:
+        if not isinstance(cm, dict):
+            fail("cost_model must be a dict")
+        if not isinstance(cm.get("schedule"), str):
+            fail("cost_model.schedule must be a string")
+        hw = cm.get("hardware")
+        if not isinstance(hw, dict) or not isinstance(
+                hw.get("name"), str) or not isinstance(
+                hw.get("peak_flops"), (int, float)):
+            fail("cost_model.hardware needs a str name and numeric "
+                 "peak_flops")
+        pred = cm.get("predicted")
+        if not isinstance(pred, dict):
+            fail("cost_model.predicted must be a dict")
+        for key in ("step_s", "bubble_table_exact", "bubble_closed_form"):
+            if not isinstance(pred.get(key), (int, float)):
+                fail(f"cost_model.predicted.{key} must be a number")
+        comm = cm.get("comm")
+        if not isinstance(comm, dict) or not isinstance(
+                comm.get("hops"), int):
+            fail("cost_model.comm needs an int 'hops'")
+        measured = cm.get("measured")
+        if measured is not None:
+            if not isinstance(measured, dict):
+                fail("cost_model.measured must be a dict")
+            for key in ("step_s", "mfu"):
+                if not isinstance(measured.get(key), (int, float)):
+                    fail(f"cost_model.measured.{key} must be a number")
+        attrib = cm.get("attribution")
+        if attrib is not None:
+            if not isinstance(attrib, dict):
+                fail("cost_model.attribution must be a dict")
+            for key in ("compute_s", "comm_s", "bubble_s"):
+                if not isinstance(attrib.get(key), (int, float)):
+                    fail(f"cost_model.attribution.{key} must be a number")

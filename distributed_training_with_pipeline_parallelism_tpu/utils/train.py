@@ -498,6 +498,7 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
     profiling = False
     preempted = False
     last_done = start_step - 1  # newest step whose outputs params hold
+    data_shape = None  # (batch, seq) of the first batch, for the cost model
 
     def _finalize_report():
         if report is None:
@@ -507,6 +508,26 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
             report.gauge("final_loss", history[-1][1])
         if telemetry is not None:
             report.attach_telemetry(telemetry)
+            # close the predicted<->measured loop: roofline section over
+            # the same compiled table the stamps were recorded against
+            # (docs/observability.md "Cost model & MFU"); never lets an
+            # accounting error take down the run's report
+            if telemetry.events and data_shape is not None:
+                try:
+                    from ..analysis.cost_model import cost_model_section
+                    from ..parallel.schedules import compile_schedule
+                    cs = compile_schedule(sched.name, mesh.shape["pipe"],
+                                          sched.n_virtual,
+                                          sched.n_microbatches)
+                    if (telemetry.table is not None
+                            and cs.table.shape == telemetry.table.shape):
+                        report.attach_cost_model(cost_model_section(
+                            cs, cfg, batch_size=data_shape[0],
+                            seq_length=data_shape[1],
+                            remat_backward=remat_backward,
+                            telemetry=telemetry))
+                except Exception as e:
+                    report.event("cost_model_error", error=str(e))
         res = {}
         if mgr is not None:
             res.update(mgr.stats())
@@ -542,6 +563,8 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                             print(f"profile trace written to {profile_dir}",
                                   flush=True)
                 tokens, targets = next(data)
+                if data_shape is None:
+                    data_shape = (int(tokens.shape[0]), int(tokens.shape[1]))
                 # first executed step = trace + compile + run; the report's
                 # compile_s timer brackets it (forced, so the timer is honest)
                 first = report is not None and i == start_step

@@ -203,46 +203,103 @@ def parse(log_dir: str, n_steps: int) -> dict:
     }
 
 
+def cost_model_breakdown(cm: dict) -> None:
+    """Print a manifest's ``cost_model`` section: predicted vs measured
+    step time, bubble fractions, MFU, comm volume, and the critical-path
+    attribution when present (analysis.cost_model)."""
+    hw = cm.get("hardware") or {}
+    print(f"\n--- cost model: {cm.get('schedule', '?')} "
+          f"D={cm.get('n_devices', '?')} V={cm.get('n_virtual', '?')} "
+          f"M={cm.get('n_microbatches', '?')} "
+          f"policy={cm.get('backward_policy', '?')} "
+          f"on {hw.get('name', '?')} ---")
+    pred = cm.get("predicted") or {}
+    meas = cm.get("measured") or {}
+    comm = cm.get("comm") or {}
+
+    def _ms(v):
+        return f"{v * 1e3:.3f} ms" if isinstance(v, (int, float)) else "n/a"
+
+    def _pct(v):
+        return f"{v:.1%}" if isinstance(v, (int, float)) else "n/a"
+
+    print(f"{'':18s} {'predicted':>12s} {'measured':>12s}")
+    print(f"{'step time':18s} {_ms(pred.get('step_s')):>12s} "
+          f"{_ms(meas.get('step_s')):>12s}")
+    print(f"{'bubble (exact)':18s} "
+          f"{_pct(pred.get('bubble_table_exact')):>12s} "
+          f"{_pct(meas.get('bubble_measured_mean')):>12s}")
+    print(f"bubble closed-form {_pct(pred.get('bubble_closed_form'))}, "
+          f"weighted {_pct(pred.get('bubble_weighted'))}")
+    if isinstance(comm.get("hops"), (int, float)):
+        print(f"comm: {comm['hops']} ppermute hops x "
+              f"{comm.get('bytes_per_hop', 0) / 1024:.1f} KiB")
+    if isinstance(meas.get("mfu"), (int, float)):
+        print(f"MFU {meas['mfu']:.2%}  HFU {_pct(meas.get('hfu'))}  "
+              f"tokens/s {meas.get('tokens_per_sec', 0):.1f}"
+              + ("  [cpu proxy peak — not a chip utilization]"
+                 if hw.get("cpu_proxy") else ""))
+    attr = cm.get("attribution")
+    if isinstance(attr, dict):
+        total = attr.get("total_s") or 0.0
+        print(f"critical path over {attr.get('n_ticks', '?')} ticks "
+              f"({_ms(total)}): compute {_ms(attr.get('compute_s'))}, "
+              f"comm {_ms(attr.get('comm_s'))}, "
+              f"bubble {_ms(attr.get('bubble_s'))}; straggler "
+              f"{attr.get('straggler_stage', '?')}")
+
+
 def report_breakdown(manifest: dict) -> None:
-    """Print the telemetry section of a run-report manifest: phase/tick
-    timeline and the per-stage F/B/W/idle attribution. Pure host-side —
-    works on any machine with just the JSON in hand."""
+    """Print the telemetry + cost_model sections of a run-report manifest:
+    phase/tick timeline, per-stage F/B/W/idle attribution, predicted vs
+    measured roofline. Pure host-side — works on any machine with just
+    the JSON in hand. Degrades gracefully: missing sections are skipped
+    with a note; a report with neither section exits with a clear
+    message instead of a traceback."""
     meta = manifest.get("meta", {})
     tel = manifest.get("telemetry")
-    if not tel:
+    cm = manifest.get("cost_model")
+    if not tel and not cm:
         raise SystemExit(
-            "report has no 'telemetry' section — the run was not "
-            "instrumented (pass a PipelineTelemetry into make_pipeline_step "
-            "/ fit and re-run; docs/observability.md)")
+            "report has neither a 'telemetry' nor a 'cost_model' section — "
+            "the run was not instrumented (pass a PipelineTelemetry into "
+            "make_pipeline_step / fit and re-run; docs/observability.md)")
+    tel = tel or {}
     print(f"=== run report: {meta.get('name', '?')} "
           f"(executor={tel.get('executor', '?')}, "
           f"backend={meta.get('backend', '?')}) ===")
-    timeline = tel.get("timeline", [])
+    timeline = tel.get("timeline") or []
     if timeline:
         print(f"\n{'segment':12s} {'ticks':>12s} {'dur ms':>9s} "
               f"{'ms/tick':>9s}")
         for rec in timeline:
             kind = rec.get("kind", "?")
-            label = (f"phase {rec['phase']}" if kind == "phase"
+            label = (f"phase {rec.get('phase', '?')}" if kind == "phase"
                      else f"tick {rec.get('tick', '?')}" if kind == "tick"
                      else kind)
             t0, n = rec.get("start_tick", 0), max(rec.get("n_ticks", 1), 1)
             dur = rec.get("duration_s") or 0.0
             print(f"{label:12s} {f'{t0}..{t0 + n - 1}':>12s} "
                   f"{dur * 1e3:9.3f} {dur / n * 1e3:9.3f}")
+    else:
+        print("(no measured timeline in this report)")
     sb = tel.get("stage_breakdown")
     if sb:
-        print(f"\ntotal {sb['total_s'] * 1e3:.3f} ms — split "
-              f"F {sb['f_frac']:.1%} / B {sb['b_frac']:.1%} / "
-              f"W {sb['w_frac']:.1%}; mean measured bubble "
-              f"{sb['bubble_measured_mean']:.1%}")
+        print(f"\ntotal {sb.get('total_s', 0.0) * 1e3:.3f} ms — split "
+              f"F {sb.get('f_frac', 0.0):.1%} / B {sb.get('b_frac', 0.0):.1%}"
+              f" / W {sb.get('w_frac', 0.0):.1%}; mean measured bubble "
+              f"{sb.get('bubble_measured_mean', 0.0):.1%}")
         print(f"{'stage':>6s} {'F ms':>8s} {'B ms':>8s} {'W ms':>8s} "
               f"{'idle ms':>8s} {'bubble':>7s}")
-        for row in sb["per_stage"]:
-            print(f"{row['device']:6d} {row['f_s'] * 1e3:8.3f} "
-                  f"{row['b_s'] * 1e3:8.3f} {row['w_s'] * 1e3:8.3f} "
-                  f"{row['idle_s'] * 1e3:8.3f} "
-                  f"{row['bubble_measured']:6.1%}")
+        for row in sb.get("per_stage") or []:
+            print(f"{row.get('device', -1):6d} "
+                  f"{row.get('f_s', 0.0) * 1e3:8.3f} "
+                  f"{row.get('b_s', 0.0) * 1e3:8.3f} "
+                  f"{row.get('w_s', 0.0) * 1e3:8.3f} "
+                  f"{row.get('idle_s', 0.0) * 1e3:8.3f} "
+                  f"{row.get('bubble_measured', 0.0):6.1%}")
+    if isinstance(cm, dict):
+        cost_model_breakdown(cm)
 
 
 def main():

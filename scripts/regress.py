@@ -1,0 +1,195 @@
+"""Perf-regression sentinel: compare a run report against its history.
+
+Reads one or more RunReport manifests (``report.json`` from
+``fit``/``bench.py``/``scripts/telemetry_smoke.py`` — anything carrying
+gauges and/or a ``cost_model`` section), extracts the headline perf
+numbers, appends them as one JSON line each to ``results/history.jsonl``,
+and fails when a number regresses against the median of prior runs of
+the same (name, backend, schedule) group:
+
+- ``tokens_per_sec`` drops by more than ``--threshold`` (default 10%),
+- ``mfu`` drops by more than the threshold,
+- ``bubble`` (measured bubble fraction when the report has telemetry,
+  else the table-exact prediction) rises by more than the threshold.
+
+CPU-proxy runs (backend == "cpu") are always warn-only: a simulated-CPU
+host serializes every "parallel" tick, so its wall-clock jitters with
+machine load and a hard gate would flake (docs/results.md §2). Pass
+``--warn-only`` to force the same behavior elsewhere (the tier-1/CI leg
+does: CI hosts are shared). The first run of a group establishes the
+baseline and always passes.
+
+Stdlib only — no jax, no numpy: the sentinel must run even when the
+accelerator stack is the thing that broke.
+
+Usage::
+
+    python scripts/regress.py --report /tmp/telemetry_smoke/report.json \
+        [--history results/history.jsonl] [--threshold 0.1] \
+        [--window 20] [--warn-only]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _get(d, *path):
+    """Nested dict lookup; None on any missing hop."""
+    for key in path:
+        if not isinstance(d, dict):
+            return None
+        d = d.get(key)
+    return d
+
+
+def extract_metrics(manifest) -> dict:
+    """One history row from a RunReport manifest (missing metrics -> None)."""
+    gauges = manifest.get("gauges") or {}
+    cm = manifest.get("cost_model")
+    tokens_per_sec = None
+    for key in ("throughput", "headline_tokens_per_sec", "tokens_per_sec",
+                "serve_continuous_tokens_per_sec"):
+        if isinstance(gauges.get(key), (int, float)):
+            tokens_per_sec = float(gauges[key])
+            break
+    if tokens_per_sec is None:
+        tokens_per_sec = _get(cm, "measured", "tokens_per_sec")
+    mfu = _get(cm, "measured", "mfu")
+    if mfu is None and isinstance(gauges.get("headline_mfu"), (int, float)):
+        mfu = float(gauges["headline_mfu"])
+    if mfu is None and isinstance(gauges.get("mfu"), (int, float)):
+        mfu = float(gauges["mfu"])
+    bubble = _get(manifest, "telemetry", "stage_breakdown",
+                  "bubble_measured_mean")
+    if bubble is None:
+        bubble = _get(cm, "predicted", "bubble_table_exact")
+    return {
+        "t": time.time(),
+        "name": _get(manifest, "meta", "name") or "unknown",
+        "backend": _get(manifest, "meta", "backend") or "unknown",
+        "schedule": (_get(cm, "schedule")
+                     or _get(manifest, "meta", "schedule", "name")
+                     or "unknown"),
+        "tokens_per_sec": tokens_per_sec,
+        "mfu": mfu,
+        "bubble": bubble,
+        "predicted_step_s": _get(cm, "predicted", "step_s"),
+        "measured_step_s": _get(cm, "measured", "step_s"),
+    }
+
+
+def load_history(path):
+    rows = []
+    if os.path.exists(path):
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    try:
+                        rows.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass  # a torn tail line never blocks the sentinel
+    return rows
+
+
+def _median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def check(row, history, threshold, window) -> list:
+    """Regression messages for ``row`` vs the same group's history."""
+    group = [r for r in history
+             if r.get("name") == row["name"]
+             and r.get("backend") == row["backend"]
+             and r.get("schedule") == row["schedule"]]
+    group = group[-window:]
+    if not group:
+        return []
+    problems = []
+    for key, direction in (("tokens_per_sec", "down"), ("mfu", "down"),
+                           ("bubble", "up")):
+        val = row.get(key)
+        prior = [r[key] for r in group
+                 if isinstance(r.get(key), (int, float))]
+        if val is None or not prior:
+            continue
+        base = _median(prior)
+        if direction == "down" and val < base * (1.0 - threshold):
+            problems.append(
+                f"{key} regressed: {val:.6g} < {base:.6g} "
+                f"(median of {len(prior)}) - {threshold:.0%}")
+        elif direction == "up" and base >= 0 and (
+                val > base * (1.0 + threshold) + 1e-9):
+            problems.append(
+                f"{key} regressed: {val:.6g} > {base:.6g} "
+                f"(median of {len(prior)}) + {threshold:.0%}")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--report", action="append", required=True,
+                    help="RunReport manifest path (repeatable)")
+    ap.add_argument("--history", default="results/history.jsonl")
+    ap.add_argument("--threshold", type=float, default=0.1,
+                    help="relative regression tolerance (default 0.1)")
+    ap.add_argument("--window", type=int, default=20,
+                    help="prior runs per group the median is taken over")
+    ap.add_argument("--warn-only", action="store_true",
+                    help="report regressions but exit 0")
+    args = ap.parse_args(argv)
+
+    history = load_history(args.history)
+    rc = 0
+    new_rows = []
+    for path in args.report:
+        try:
+            with open(path) as fh:
+                manifest = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"regress: cannot read {path}: {e}", file=sys.stderr)
+            rc = max(rc, 2 if not args.warn_only else 0)
+            continue
+        row = extract_metrics(manifest)
+        problems = check(row, history, args.threshold, args.window)
+        label = f"{row['name']}/{row['schedule']}@{row['backend']}"
+        cpu_proxy = row["backend"] == "cpu"
+        if not problems:
+            n_prior = sum(1 for r in history
+                          if r.get("name") == row["name"]
+                          and r.get("backend") == row["backend"]
+                          and r.get("schedule") == row["schedule"])
+            verdict = ("baseline established" if n_prior == 0
+                       else f"OK vs {n_prior} prior run(s)")
+            print(f"regress: {label}: {verdict} "
+                  f"(tokens/s={row['tokens_per_sec']}, mfu={row['mfu']}, "
+                  f"bubble={row['bubble']})")
+        else:
+            soft = args.warn_only or cpu_proxy
+            tag = ("WARN (cpu proxy)" if cpu_proxy and not args.warn_only
+                   else "WARN" if soft else "FAIL")
+            for p in problems:
+                print(f"regress: {tag}: {label}: {p}",
+                      file=sys.stderr if not soft else sys.stdout)
+            if not soft:
+                rc = 1
+        new_rows.append(row)
+        history.append(row)
+
+    if new_rows:
+        hist_dir = os.path.dirname(args.history)
+        if hist_dir:
+            os.makedirs(hist_dir, exist_ok=True)
+        with open(args.history, "a") as fh:
+            for row in new_rows:
+                fh.write(json.dumps(row) + "\n")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,12 +7,15 @@ artifact): build a 4-stage 1F1B step on a simulated CPU mesh with a
 
 - a measured timeline covering every phase of the compiled schedule,
 - a per-stage F/B/W/idle breakdown,
+- a ``cost_model`` section whose table-exact bubble prediction matches
+  the static verifier's idle fraction *exactly* (same integer count),
+- a Perfetto ``trace.json`` that round-trips as valid Chrome-trace JSON,
 - a ``RunReport`` manifest that passes ``validate_report``.
 
-Writes ``report.json`` (+ ``events.jsonl``) into the output directory
-(argv[1], default ``/tmp/telemetry_smoke``) and exits 0 on success,
-1 with a reason on any violation. ~1 pipeline compile of a tiny model:
-target well under a minute on a CI host.
+Writes ``report.json`` (+ ``events.jsonl``, ``trace.json``) into the
+output directory (argv[1], default ``/tmp/telemetry_smoke``) and exits 0
+on success, 1 with a reason on any violation. ~1 pipeline compile of a
+tiny model: target well under a minute on a CI host.
 """
 
 import os
@@ -101,10 +104,45 @@ def main() -> int:
         print("telemetry_smoke: schedule table failed static verification",
               file=sys.stderr)
         return 1
+
+    # roofline accounting: the table-exact bubble prediction must agree
+    # with the verifier's simulated timeline to the last integer cell
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.cost_model import (
+        cost_model_section)
+    from distributed_training_with_pipeline_parallelism_tpu.utils.telemetry import (
+        write_perfetto_trace)
+    sec = cost_model_section(cs, cfg, batch_size=int(tokens.shape[0]),
+                             seq_length=int(tokens.shape[1]),
+                             telemetry=tel, table_report=table_report)
+    report.attach_cost_model(sec)
+    n_cells = cs.table.shape[0] * cs.n_devices
+    idle_frac = table_report.unit_counts["idle"] / n_cells
+    if abs(sec["predicted"]["bubble_table_exact"] - idle_frac) > 0.0:
+        print(f"telemetry_smoke: table-exact bubble "
+              f"{sec['predicted']['bubble_table_exact']} != verifier idle "
+              f"fraction {idle_frac}", file=sys.stderr)
+        return 1
+    if "mfu" not in sec.get("measured", {}):
+        print("telemetry_smoke: cost_model has no measured MFU",
+              file=sys.stderr)
+        return 1
+
+    trace_path = write_perfetto_trace(tel, os.path.join(out_dir,
+                                                        "trace.json"))
+    import json
+    with open(trace_path) as fh:
+        trace = json.load(fh)
+    if not trace.get("traceEvents"):
+        print("telemetry_smoke: empty Perfetto trace", file=sys.stderr)
+        return 1
+
     manifest = report.write()
     validate_report(manifest)  # write() validates too; belt and suspenders
     print(f"telemetry_smoke: OK — {len(phases)} phases over "
-          f"{cs.table.shape[0]} ticks, report at "
+          f"{cs.table.shape[0]} ticks, bubble(table-exact)="
+          f"{sec['predicted']['bubble_table_exact']:.4f}, "
+          f"mfu={sec['measured']['mfu']:.2e}, "
+          f"{len(trace['traceEvents'])} trace events, report at "
           f"{os.path.join(out_dir, 'report.json')}")
     return 0
 

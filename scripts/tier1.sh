@@ -35,6 +35,17 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
   exit 1
 fi
 echo "TELEMETRY_SMOKE=ok"
+# Perf-regression sentinel on the smoke's report (warn-only: CI hosts
+# are shared, so wall-clock gating would flake — the appended
+# results/history.jsonl rides the CI artifacts for offline triage;
+# docs/performance.md "Regression sentinel").
+if ! timeout -k 10 60 \
+    python scripts/regress.py --report /tmp/telemetry_smoke/report.json \
+    --history results/history.jsonl --warn-only; then
+  echo "REGRESS=fail"
+  exit 1
+fi
+echo "REGRESS=ok"
 # Serving liveness next (same discipline): a small continuous-batching
 # run must bit-match the single-device oracle and produce a validated
 # report with TTFT/TPOT rows. Lands in /tmp/serve_smoke for CI upload.
