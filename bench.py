@@ -182,12 +182,13 @@ def _time_step(step, params, tokens, targets, num_iterations):
 
 
 def run_config(cfg, batch_size, seq_length, num_iterations=20,
-               schedule="GPipe", n_microbatches=4,
+               schedule="GPipe", n_microbatches=4, n_virtual=1,
                force_tick_executor=False, remat_backward=None,
                unroll_ticks=None, n_pipe=None) -> dict:
     if n_pipe is None:  # 1-D pipeline mesh over every visible chip
         n_pipe = len(jax.devices())
-    sched = dtpp.ScheduleConfig(name=schedule, n_microbatches=n_microbatches)
+    sched = dtpp.ScheduleConfig(name=schedule, n_microbatches=n_microbatches,
+                                n_virtual=n_virtual)
     mesh = make_mesh(n_pipe=n_pipe)
     step = make_pipeline_step(cfg, mesh, sched,
                               force_tick_executor=force_tick_executor,
@@ -490,8 +491,106 @@ def run_serve() -> dict:
     }
 
 
+def run_searched(artifact_path: str, num_iterations: int = 5) -> dict:
+    """``--schedule-artifact PATH``: benchmark a certified searched
+    schedule as a first-class citizen.
+
+    Registers the artifact (full re-certification + pin — a tampered
+    table never reaches the executor), runs a small proxy model through
+    the real tick executor under the searched schedule AND under 1F1B on
+    the same shape, and reports both rows plus the artifact's predicted
+    cost. The mesh must match the artifact's certified device count; a
+    host without enough devices re-creates the simulated-cpu client the
+    same way ``--serve`` does (rows labelled a proxy)."""
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules import (
+        register_schedule_artifact, registered_artifact_info)
+    from distributed_training_with_pipeline_parallelism_tpu.utils.telemetry import (
+        RunReport, validate_report)
+    backend = _init_backend()
+    cs = register_schedule_artifact(artifact_path)
+    D, V, M = cs.n_devices, cs.n_virtual, cs.n_microbatches
+    if backend["n_devices"] != D:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            from jax.extend import backend as _jex_backend
+            _jex_backend.clear_backends()
+        except Exception:  # pragma: no cover - version-dependent internals
+            pass
+        backend["backend"] = jax.devices()[0].platform
+        backend["n_devices"] = len(jax.devices())
+        if backend["n_devices"] < D:
+            raise SystemExit(
+                f"bench: artifact needs {D} devices; host has "
+                f"{backend['n_devices']} (set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={D})")
+    # smallest model the shape admits: layers divisible by D*V stages,
+    # test-suite-scale width — the row compares schedules, not hardware
+    proxy_cfg = dtpp.ModelConfig(dim=64, n_layers=2 * D * V, n_heads=4,
+                                 vocab_size=256, ffn_dim=128, max_seq_len=64)
+    headline = run_config(proxy_cfg, 4 * M, 64, num_iterations,
+                          schedule=cs.name, n_microbatches=M, n_virtual=V,
+                          force_tick_executor=True, n_pipe=D)
+    extra = {"headline": headline, "n_devices": D, **backend,
+             "schedule_artifact": {"path": artifact_path,
+                                   **(registered_artifact_info(cs.name)
+                                      or {})}}
+    art = None
+    try:
+        import json as _json
+        with open(artifact_path) as fh:
+            art = _json.load(fh)
+        extra["predicted"] = art.get("predicted")
+        extra["baselines"] = art.get("baselines")
+    except Exception:  # pragma: no cover - artifact already certified above
+        pass
+    try:
+        extra["one_f_one_b"] = run_config(
+            proxy_cfg, 4 * M, 64, num_iterations, schedule="1F1B",
+            n_microbatches=M, force_tick_executor=True, n_pipe=D)
+    except Exception as e:
+        extra["one_f_one_b"] = {"error": str(e)}
+    if backend["backend"] == "cpu":
+        extra["headline_proxy"] = (
+            "cpu host serializes every tick — scheduling comparison "
+            "only, NOT accelerator numbers (docs/results.md §2)")
+    report = RunReport(name="bench_searched")
+    report.set_meta(n_devices=D, backend=backend["backend"],
+                    schedule={"name": cs.name, "n_microbatches": M,
+                              "n_virtual": V},
+                    schedule_artifact=extra["schedule_artifact"])
+    for k, v in headline.items():
+        report.gauge(f"headline_{k}", v)
+    manifest = report.manifest()
+    validate_report(manifest)
+    path = os.environ.get("BENCH_REPORT_PATH")
+    if path:
+        with open(path, "w") as fh:
+            json.dump(manifest, fh, indent=2)
+            fh.write("\n")
+        extra["run_report_path"] = path
+    else:
+        extra["run_report"] = manifest
+    extra["metric_override"] = (
+        f"searched-schedule executor throughput ({cs.name}, certified "
+        f"artifact, D={D}, V={V}, M={M}, proxy model L{proxy_cfg.n_layers})")
+    return _result(headline, extra, D)
+
+
 if __name__ == "__main__":
-    if "--serve" in sys.argv:
+    if "--schedule-artifact" in sys.argv:
+        i = sys.argv.index("--schedule-artifact")
+        if i + 1 >= len(sys.argv):
+            raise SystemExit("bench: --schedule-artifact needs a PATH")
+        # the artifact names its device count; make sure a cpu client can
+        # simulate it (must land in XLA_FLAGS before the first backend init)
+        if "xla_force_host_platform_device_count" not in os.environ.get(
+                "XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                " --xla_force_host_platform_device_count=8")
+        print(json.dumps(run_searched(sys.argv[i + 1])))
+    elif "--serve" in sys.argv:
         # must land in XLA_FLAGS before the first backend init; it only
         # affects the cpu client, so it is harmless when a TPU is present
         if "xla_force_host_platform_device_count" not in os.environ.get(

@@ -21,6 +21,12 @@ distributed_training_with_pipeline_parallelism_tpu.analysis``):
   bubble fractions, MFU/HFU from measured step time) — the predicted
   side of the predicted↔measured loop ``utils.telemetry`` closes
   (docs/observability.md "Cost model & MFU").
+- :mod:`.schedule_search` — the certifying schedule compiler: seeded,
+  deterministic search over per-device action orders whose objective is
+  the cost model's predicted step time and whose hard constraints are
+  the static proofs above (every emitted artifact is certified
+  hazard-free and budget-bounded; docs/static_analysis.md "Schedule
+  compiler").
 
 The builders call the table passes at table-build time behind the
 ``DTPP_VERIFY_TABLES`` env flag (on in tests, off by default in
@@ -90,6 +96,10 @@ _LAZY = {
     "Hazard": ("table_check", "Hazard"),
     "TableReport": ("table_check", "TableReport"),
     "check_table": ("table_check", "check_table"),
+    "check_table_cached": ("table_check", "check_table_cached"),
+    "check_table_baseline": ("table_check", "check_table_baseline"),
+    "recheck_after_swap": ("table_check", "recheck_after_swap"),
+    "TableCheckBaseline": ("table_check", "TableCheckBaseline"),
     "check_forward_table": ("table_check", "check_forward_table"),
     "check_serving_ring": ("table_check", "check_serving_ring"),
     "static_analysis_section": ("table_check", "static_analysis_section"),
@@ -112,6 +122,12 @@ _LAZY = {
     "fwd_flops_per_token": ("cost_model", "fwd_flops_per_token"),
     "resolve_backward_policy": ("cost_model", "resolve_backward_policy"),
     "backward_weights": ("cost_model", "backward_weights"),
+    "predicted_step_time": ("cost_model", "predicted_step_time"),
+    "SearchSpec": ("schedule_search", "SearchSpec"),
+    "SearchResult": ("schedule_search", "SearchResult"),
+    "search_schedule": ("schedule_search", "search_schedule"),
+    "seed_orders": ("schedule_search", "seed_orders"),
+    "run_search": ("cli", "run_search"),
 }
 
 

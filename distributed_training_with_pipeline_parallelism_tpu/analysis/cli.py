@@ -144,8 +144,54 @@ def run_jaxpr_audits() -> Dict[str, Any]:
     return out
 
 
+def run_search(out_path: Optional[str] = None, *, seed: int = 0,
+               iterations: int = 300) -> Dict[str, Any]:
+    """The ``--search`` pass: run the certifying schedule compiler on two
+    small shapes (pure numpy — no jax backend needed), assert every
+    winner is certified and beats or ties 1F1B's table-exact bubble
+    fraction, and optionally save the first winner's artifact JSON."""
+    from ..parallel.schedules import save_schedule_artifact
+    from .schedule_search import SearchSpec, search_schedule
+
+    # Case 1: split-backward greedy seeds — must strictly beat 1F1B's
+    # bubble at D=4 (the acceptance bar). Case 2: full-backward search
+    # from the builtin seeds — 1F1B is in the pool, so the winner ties
+    # it at worst (split cannot beat 1F1B's idle fraction at D=2: the
+    # elided stage-0 dgrad leaves the first device structurally idle).
+    specs = [
+        SearchSpec(n_devices=4, n_microbatches=8, seed=seed,
+                   iterations=iterations),
+        SearchSpec(n_devices=2, n_microbatches=4, split_backward=False,
+                   seed=seed, iterations=iterations),
+    ]
+    out: Dict[str, Any] = {"cases": [], "ok": True}
+    for i, spec in enumerate(specs):
+        res = search_schedule(spec)
+        beats = res.beats_1f1b
+        case = {
+            "case": f"search[D={spec.n_devices},V={spec.n_virtual},"
+                    f"M={spec.n_microbatches},seed={spec.seed}]",
+            "certified": res.report.ok,
+            "bubble_table_exact": res.predicted["bubble_table_exact"],
+            "bubble_1f1b": res.baselines.get("1F1B", {}).get(
+                "bubble_table_exact"),
+            "beats_or_ties_1f1b": beats,
+            "makespan": res.predicted["makespan"],
+            "winning_seed": res.stats["winning_seed"],
+            "evaluated": res.stats["evaluated"],
+        }
+        case_ok = bool(res.report.ok) and beats is not False
+        out["cases"].append(case)
+        out["ok"] = out["ok"] and case_ok
+        if i == 0 and out_path:
+            save_schedule_artifact(res.artifact, out_path)
+            case["artifact"] = out_path
+    return out
+
+
 def run_checks(tables: bool = True, lint: bool = True,
-               jaxpr: bool = False) -> Dict[str, Any]:
+               jaxpr: bool = False, search: bool = False,
+               search_out: Optional[str] = None) -> Dict[str, Any]:
     report: Dict[str, Any] = {"verifier_version": VERIFIER_VERSION}
     ok = True
     if tables:
@@ -157,6 +203,9 @@ def run_checks(tables: bool = True, lint: bool = True,
     if jaxpr:
         report["jaxpr"] = run_jaxpr_audits()
         ok = ok and report["jaxpr"]["ok"]
+    if search:
+        report["search"] = run_search(search_out)
+        ok = ok and report["search"]["ok"]
     report["ok"] = ok
     return report
 
@@ -174,6 +223,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--jaxpr", action="store_true",
                     help="trace + audit step functions (needs a jax "
                          "backend with >= 4 pipe devices)")
+    ap.add_argument("--search", action="store_true",
+                    help="run the certifying schedule compiler on small "
+                         "shapes and assert the winners are certified and "
+                         "beat/tie 1F1B's table-exact bubble")
+    ap.add_argument("--search-out", metavar="PATH",
+                    help="with --search: save the first winner's schedule "
+                         "artifact JSON to PATH")
     ap.add_argument("--all", action="store_true", help="all three passes")
     ap.add_argument("--json", metavar="PATH",
                     help="write the structured report to PATH")
@@ -184,10 +240,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     tables = args.tables or args.all
     lint = args.lint or args.all
     jaxpr = args.jaxpr or args.all
-    if not (tables or lint or jaxpr):
+    search = args.search or args.all
+    if not (tables or lint or jaxpr or search):
         tables = lint = True  # cheap default: no jax import needed
 
-    report = run_checks(tables=tables, lint=lint, jaxpr=jaxpr)
+    report = run_checks(tables=tables, lint=lint, jaxpr=jaxpr,
+                        search=search, search_out=args.search_out)
 
     if not args.quiet:
         if "tables" in report:
@@ -210,6 +268,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                       f"callbacks={case['n_callbacks']})")
                 for p in case["problems"]:
                     print(f"  {p}")
+        if "search" in report:
+            for case in report["search"]["cases"]:
+                status = ("ok" if case["certified"]
+                          and case["beats_or_ties_1f1b"] is not False
+                          else "FAIL")
+                print(f"search: {case['case']}: {status} "
+                      f"(bubble={case['bubble_table_exact']:.4f} vs "
+                      f"1F1B={case['bubble_1f1b']}, "
+                      f"seed={case['winning_seed']})")
         print(f"check: {'OK' if report['ok'] else 'FAILED'}")
 
     if args.json:

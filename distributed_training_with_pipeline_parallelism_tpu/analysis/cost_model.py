@@ -37,7 +37,7 @@ perf-regression sentinel.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -50,7 +50,8 @@ __all__ = [
     "HardwareSpec", "CPU_PROXY", "TPU_PRESETS", "hardware_spec_for",
     "detect_hardware", "fwd_flops_per_token", "train_flops_per_token",
     "resolve_backward_policy", "backward_weights", "dtype_bytes",
-    "cost_model_section", "serving_cost_model_section",
+    "predicted_step_time", "cost_model_section",
+    "serving_cost_model_section",
 ]
 
 # The ring columns a hop can bank into, with the offset the sender sits
@@ -216,6 +217,35 @@ def _hops_per_tick(table: np.ndarray) -> np.ndarray:
     return hops
 
 
+def predicted_step_time(table: np.ndarray, unit_s: Tuple[float, float, float],
+                        hop_s: float, hops_total: int) -> Dict[str, float]:
+    """The exact time model ``cost_model_section`` prices ``predicted``
+    with, factored out so the schedule search's objective is *identical*
+    to the reported cost: lockstep per-tick max across devices (every
+    device waits for the tick's straggler), ring hops serialized after
+    compute (``step_s``) or overlapped with the launching tick
+    (``step_s_overlapped``). ``unit_s`` is (F, B, W) seconds per unit —
+    absolute (unit FLOPs / peak) or abstract forward-unit equivalents;
+    the argmin over candidate tables is scale-invariant either way."""
+    activity = table_unit_activity(table)          # [T, D, (F,B,W,idle)]
+    vec = np.array([unit_s[0], unit_s[1], unit_s[2], 0.0], dtype=np.float64)
+    per_dev_tick_s = activity.astype(np.float64) @ vec          # [T, D]
+    compute_tick_s = per_dev_tick_s.max(axis=1)                 # [T]
+    t_compute_s = float(compute_tick_s.sum())
+    t_comm_s = float(hops_total) * hop_s
+    hops_per_tick = _hops_per_tick(table)
+    idle_cells = int(activity[:, :, 3].sum())
+    T, D = int(table.shape[0]), int(table.shape[1])
+    return {
+        "compute_s": t_compute_s,
+        "comm_s": t_comm_s,
+        "step_s": t_compute_s + t_comm_s,
+        "step_s_overlapped": float(
+            np.maximum(compute_tick_s, hops_per_tick * hop_s).sum()),
+        "bubble_table_exact": idle_cells / float(T * D),
+    }
+
+
 def cost_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
                        seq_length: int,
                        hardware: Optional[HardwareSpec] = None,
@@ -262,24 +292,20 @@ def cost_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
         table_report = check_table(cs)
     hops_total = int(table_report.predicted_ppermutes)
     hop_s = bytes_per_hop / hw.ici_bytes_per_s
-    hops_per_tick = _hops_per_tick(table)
 
-    # --- roofline: lockstep per-tick max across devices (the executor's
-    # actual synchronization model — every device waits for the tick's
-    # straggler), hops serialized after compute (serial bound) or
-    # overlapped with the launching tick (overlap bound)
-    unit_s = np.array([unit_f, unit_b, unit_w, 0.0]) / hw.peak_flops
-    per_dev_tick_s = activity.astype(np.float64) @ unit_s      # [T, D]
-    compute_tick_s = per_dev_tick_s.max(axis=1)                # [T]
-    t_compute_s = float(compute_tick_s.sum())
-    t_comm_s = float(hops_total) * hop_s
+    # --- roofline: lockstep per-tick max across devices, hops serialized
+    # or overlapped — the shared time model (predicted_step_time) the
+    # schedule search optimizes, so search objective == reported cost
+    tm = predicted_step_time(
+        table, (unit_f / hw.peak_flops, unit_b / hw.peak_flops,
+                unit_w / hw.peak_flops), hop_s, hops_total)
+    t_compute_s = tm["compute_s"]
+    t_comm_s = tm["comm_s"]
     ideal_compute_s = hardware_per_step / (D * hw.peak_flops)
-    step_s_overlapped = float(
-        np.maximum(compute_tick_s, hops_per_tick * hop_s).sum())
+    step_s_overlapped = tm["step_s_overlapped"]
 
     # --- bubbles three ways (see module docstring)
-    idle_cells = int(counts[3])
-    bubble_table_exact = idle_cells / float(T * D)
+    bubble_table_exact = tm["bubble_table_exact"]
     bubble_weighted = (1.0 - ideal_compute_s / t_compute_s
                        if t_compute_s > 0 else 0.0)
     bubble_closed_form = float(analytic_bubble_fraction(

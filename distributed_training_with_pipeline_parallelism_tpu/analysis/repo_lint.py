@@ -25,6 +25,19 @@ Three rules, each encoding a contract documented elsewhere in the repo
     (docs/observability.md) requires every jitted entry point to carry
     named scopes so XProf timelines attribute time to pipeline phases.
 
+``raw-tick-table``
+    No constructing or mutating raw ``[T, D, 17]`` tick tables outside
+    ``analysis/`` and the schedule compilers (``parallel/schedules.py``,
+    ``parallel/native.py``): flagged are ``np``/``numpy``/``jnp``
+    ``full``/``zeros``/``ones``/``empty`` calls whose shape mentions
+    ``N_COLS``, subscript *stores* indexed by a ``COL_*`` column
+    constant, and ``.at[...COL_*...].set/add`` updates. Reading table
+    cells (``row[COL_FWD_V]``) stays legal everywhere — the executor
+    does exactly that. Everything else must go through
+    ``compile_schedule``/``compile_order`` or a certified schedule
+    artifact, which is what makes the static certification meaningful
+    (docs/static_analysis.md "Schedule compiler").
+
 The linter is stdlib-only (``ast``) — no jax import, safe for CI legs
 that run before any backend exists.
 """
@@ -167,6 +180,74 @@ def _lint_jit_named_scope(tree: ast.AST, path: str,
                 "for profile attribution"))
 
 
+# raw-tick-table: files allowed to build/mutate tables directly (the
+# compilers and the analysis passes themselves).
+_RAW_TABLE_ALLOWLIST = ("parallel/schedules.py", "parallel/native.py")
+_TABLE_CTORS = frozenset({"full", "zeros", "ones", "empty"})
+_TABLE_NAMESPACES = frozenset({"np", "numpy", "jnp"})
+
+
+def _mentions_name(node: ast.AST, match) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and match(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and match(sub.attr):
+            return True
+    return False
+
+
+def _lint_raw_tables(tree: ast.AST, path: str,
+                     findings: List[LintFinding]) -> None:
+    is_ncols = lambda s: s in ("N_COLS", "N_COLS_CLASSIC")
+    is_col = lambda s: s.startswith("COL_")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            dotted = _dotted_name(node.func)
+            if dotted is not None and "." in dotted:
+                ns, leaf = dotted.rsplit(".", 1)
+                if (leaf in _TABLE_CTORS
+                        and ns.rsplit(".", 1)[-1] in _TABLE_NAMESPACES
+                        and any(_mentions_name(a, is_ncols) for a in
+                                list(node.args)
+                                + [kw.value for kw in node.keywords])):
+                    findings.append(LintFinding(
+                        path, node.lineno, "raw-tick-table",
+                        f"{dotted}(...N_COLS...): raw tick-table "
+                        f"construction outside analysis//parallel/"
+                        f"schedules.py — go through compile_schedule/"
+                        f"compile_order or a certified artifact"))
+            # jnp functional update: table.at[..., COL_X].set(v)
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("set", "add", "multiply",
+                                           "max", "min")
+                    and isinstance(node.func.value, ast.Subscript)
+                    and isinstance(node.func.value.value, ast.Attribute)
+                    and node.func.value.value.attr == "at"
+                    and _mentions_name(node.func.value.slice, is_col)):
+                findings.append(LintFinding(
+                    path, node.lineno, "raw-tick-table",
+                    ".at[...COL_*...] update of a tick-table column "
+                    "outside analysis//parallel/schedules.py — compiled "
+                    "tables are immutable; go through compile_order or a "
+                    "certified artifact"))
+            continue
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            for sub in ast.walk(tgt):
+                if (isinstance(sub, ast.Subscript)
+                        and _mentions_name(sub.slice, is_col)):
+                    findings.append(LintFinding(
+                        path, sub.lineno, "raw-tick-table",
+                        "subscript store indexed by a COL_* column "
+                        "outside analysis//parallel/schedules.py — "
+                        "compiled tables are immutable; go through "
+                        "compile_order or a certified artifact"))
+
+
 def lint_source(path: str, source: str,
                 package_relpath: Optional[str] = None) -> List[LintFinding]:
     """Lint one python source. ``package_relpath`` is the path relative to
@@ -185,6 +266,9 @@ def lint_source(path: str, source: str,
     parts = rel.replace(os.sep, "/").split("/")
     if "parallel" in parts[:-1]:
         _lint_jit_named_scope(tree, path, findings)
+    rel_posix = rel.replace(os.sep, "/")
+    if parts[0] != "analysis" and rel_posix not in _RAW_TABLE_ALLOWLIST:
+        _lint_raw_tables(tree, path, findings)
     return findings
 
 

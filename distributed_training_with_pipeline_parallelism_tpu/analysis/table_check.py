@@ -34,6 +34,7 @@ for the cost of a small python interpretation.
 from __future__ import annotations
 
 import dataclasses
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -212,6 +213,11 @@ class _SlotFile:
         self.max_slot = -1
         self.live = 0
         self.live_peak = 0
+        # optional write-event recorder (set by _TrainInterp): entries
+        # (t, d, label, slot, column, prev_value, pending) let
+        # recheck_after_swap re-derive prefix WAR hazards under a *new*
+        # read schedule without reinterpreting the prefix
+        self.log: Optional[List[Tuple]] = None
 
     def write(self, slot: int, val: Tuple, t: int, d: int, column: int,
               expected_reads: List[int], hazards: List[Hazard],
@@ -224,6 +230,9 @@ class _SlotFile:
                 f"(first via {COLUMN_NAMES[written_this_tick[slot]]})"))
         written_this_tick[slot] = column
         pending = [r for r in self.reads_left.get(slot, []) if r >= t]
+        if self.log is not None:
+            self.log.append((t, d, self.label, slot, column,
+                             self.value.get(slot), tuple(pending)))
         if pending:
             hazards.append(Hazard(
                 "overwrite-live", d, t, COLUMN_NAMES[column],
@@ -283,39 +292,52 @@ def _expected_reads(table: np.ndarray, placement: str, D: int
     return act_reads, grad_reads
 
 
-def check_table(cs: CompiledSchedule) -> TableReport:
-    """Statically verify a compiled training schedule's tick table.
+class _TrainInterp:
+    """The symbolic interpreter behind :func:`check_table`, restructured as
+    a resumable object so the schedule-search loop can snapshot per-tick
+    state and revalidate only the suffix after a local move
+    (:func:`recheck_after_swap`). ``run_tick`` is the exact per-tick
+    contract: arrival stores, then F/B/W units, then send/recv pairing and
+    register rotation."""
 
-    Interprets the executor contract cell by cell (arrival stores, then
-    F/B/W units, then routed sends), accumulating every violation as a
-    located :class:`Hazard` instead of raising — see the module docstring
-    for the full check list."""
-    table = np.asarray(cs.table)
-    T, D = table.shape[0], cs.n_devices
-    S, M = cs.n_stages, cs.n_microbatches
-    pl = cs.placement
-    hazards: List[Hazard] = []
+    def __init__(self, cs: CompiledSchedule):
+        self.cs = cs
+        self.table = np.asarray(cs.table)
+        self.T, self.D = self.table.shape[0], cs.n_devices
+        self.S, self.M = cs.n_stages, cs.n_microbatches
+        self.pl = cs.placement
+        self.hazards: List[Hazard] = []
+        self.act_reads, self.grad_reads = _expected_reads(
+            self.table, self.pl, self.D)
+        self.act = [_SlotFile("act_buf", cs.n_act_slots)
+                    for _ in range(self.D)]
+        self.grad = [_SlotFile("grad_buf", cs.n_grad_slots)
+                     for _ in range(self.D)]
+        # channel registers: value delivered by last tick's ppermute
+        self.regs: Dict[str, List[Optional[Tuple]]] = {
+            key: [None] * self.D for key, _, _ in RING_CHANNELS}
+        self.fwd_done: Dict[Tuple[int, int], int] = {}
+        self.bwd_done: Dict[Tuple[int, int], int] = {}
+        self.w_done: Dict[Tuple[int, int], int] = {}
+        self.b_slots: Dict[Tuple[int, int, int], Tuple[int, int]] = {}
+        self.f_slots: Dict[Tuple[int, int], int] = {}
 
-    act_reads, grad_reads = _expected_reads(table, pl, D)
-    act = [_SlotFile("act_buf", cs.n_act_slots) for _ in range(D)]
-    grad = [_SlotFile("grad_buf", cs.n_grad_slots) for _ in range(D)]
-
-    def check_bounds(slot, n_slots, t, d, col, label):
+    def _check_bounds(self, slot, n_slots, t, d, col, label):
         if slot >= n_slots:
-            hazards.append(Hazard(
+            self.hazards.append(Hazard(
                 "slot-out-of-bounds", d, t, COLUMN_NAMES[col],
                 f"{label} slot {slot} >= declared n_slots {n_slots}"))
 
-    # channel registers: value delivered by last tick's ppermute per channel
-    regs: Dict[str, List[Optional[Tuple]]] = {
-        key: [None] * D for key, _, _ in RING_CHANNELS}
-    fwd_done: Dict[Tuple[int, int], int] = {}
-    bwd_done: Dict[Tuple[int, int], int] = {}
-    w_done: Dict[Tuple[int, int], int] = {}
-    b_slots: Dict[Tuple[int, int, int], Tuple[int, int]] = {}
-    f_slots: Dict[Tuple[int, int], int] = {}
+    def run_tick(self, t: int) -> None:
+        table, D, S, pl = self.table, self.D, self.S, self.pl
+        cs, hazards = self.cs, self.hazards
+        act, grad = self.act, self.grad
+        act_reads, grad_reads = self.act_reads, self.grad_reads
+        check_bounds = self._check_bounds
+        fwd_done, bwd_done, w_done = self.fwd_done, self.bwd_done, self.w_done
+        b_slots, f_slots = self.b_slots, self.f_slots
+        T = self.T
 
-    for t in range(T):
         sends: Dict[str, List[Optional[Tuple]]] = {
             key: [None] * D for key, _, _ in RING_CHANNELS}
         for d in range(D):
@@ -332,7 +354,7 @@ def check_table(cs: CompiledSchedule) -> TableReport:
                                         COL_STORE_F_NEG_SLOT) else grad[d]
                 reads = act_reads if buf is act[d] else grad_reads
                 check_bounds(slot, buf.n_slots, t, d, col, buf.label)
-                val = regs[key][d]
+                val = self.regs[key][d]
                 if val is None:
                     hazards.append(Hazard(
                         "store-empty-register", d, t, COLUMN_NAMES[col],
@@ -539,49 +561,258 @@ def check_table(cs: CompiledSchedule) -> TableReport:
                         f"send from device {src} at tick {t}"))
             # rotate: after the ppermute, device d holds what (d - offset)
             # sent — the channel register is indexed by receiver
-            regs[key] = [sends[key][(d - offset) % D] for d in range(D)]
+            self.regs[key] = [sends[key][(d - offset) % D]
+                              for d in range(D)]
 
-    # 6. unit counts vs the action set validate_order demands
-    activity = table_unit_activity(table).sum(axis=(0, 1))
-    n_f, n_b, n_w = int(activity[0]), int(activity[1]), int(activity[2])
-    want_f = S * M
-    want_b = (S - 1) * M if cs.split_backward else S * M
-    want_w = S * M if cs.split_backward else 0
-    for label, got, want, col in (("F", n_f, want_f, COL_FWD_M),
-                                  ("B", n_b, want_b, COL_BWD_M),
-                                  ("W", n_w, want_w, COL_W_M)):
-        if got != want:
-            hazards.append(Hazard(
-                "unit-count", -1, -1, COLUMN_NAMES[col],
-                f"{label} unit count {got} != expected {want} "
-                f"(S={S}, M={M}, split_backward={cs.split_backward})"))
-    unit_counts = {"F": n_f, "B": n_b, "W": n_w, "idle": int(activity[3])}
+    # -- snapshot/restore for the incremental recheck fast path ----------
 
-    # 7. phase-compression roundtrip (compress self-checks; assert anyway)
-    compression: Dict[str, int] = {}
-    try:
-        phases = compress_schedule(table)
-        if not np.array_equal(replay_phases(phases), table):
-            raise ScheduleError("replay does not reconstruct the table")
-        spans = phase_spans(phases)
-        if sum(n for _, n in spans) != T:
-            raise ScheduleError("phase spans do not tile the table")
-        compression = {"n_phases": len(phases), "n_rows": T}
-    except ScheduleError as e:
-        hazards.append(Hazard("compression-roundtrip", -1, -1, "table",
-                              str(e)))
+    @staticmethod
+    def _snap_files(files: List[_SlotFile]):
+        return [(dict(f.value), {k: list(v) for k, v in f.reads_left.items()},
+                 f.max_slot, f.live, f.live_peak) for f in files]
 
-    return TableReport(
-        name=cs.name, kind="train", n_devices=D, n_virtual=cs.n_virtual,
-        n_microbatches=M, placement=pl, split_backward=cs.split_backward,
-        makespan=T, hazards=hazards,
-        act_slots_used=[a.max_slot + 1 for a in act],
-        grad_slots_used=[g.max_slot + 1 for g in grad],
-        act_live_peak=[a.live_peak for a in act],
-        grad_live_peak=[g.live_peak for g in grad],
-        n_act_slots=cs.n_act_slots, n_grad_slots=cs.n_grad_slots,
-        comm=_comm_volume(table), unit_counts=unit_counts,
-        compression=compression)
+    def snapshot(self):
+        """Copy of all interpreter state *before* the next run_tick call."""
+        return (self._snap_files(self.act), self._snap_files(self.grad),
+                {k: list(v) for k, v in self.regs.items()},
+                dict(self.fwd_done), dict(self.bwd_done), dict(self.w_done),
+                dict(self.b_slots), dict(self.f_slots))
+
+    def restore(self, snap) -> None:
+        acts, grads, regs, fd, bd, wd, bs, fs = snap
+        for files, saved in ((self.act, acts), (self.grad, grads)):
+            for f, (val, rl, ms, lv, lp) in zip(files, saved):
+                f.value = dict(val)
+                f.reads_left = {k: list(v) for k, v in rl.items()}
+                f.max_slot, f.live, f.live_peak = ms, lv, lp
+        self.regs = {k: list(v) for k, v in regs.items()}
+        self.fwd_done = dict(fd)
+        self.bwd_done = dict(bd)
+        self.w_done = dict(wd)
+        self.b_slots = dict(bs)
+        self.f_slots = dict(fs)
+
+    def repatch_reads(self, start: int) -> None:
+        """Point restored slot liveness at THIS table's read schedule.
+
+        After restoring a snapshot taken on a different (prefix-identical)
+        table, each live value's pending reads must come from the *new*
+        table's derived read schedule: for a clean prefix every expected
+        read before ``start`` was consumed, so the remainder is exactly the
+        new schedule filtered to ``>= start``."""
+        for files, reads in ((self.act, self.act_reads),
+                             (self.grad, self.grad_reads)):
+            for d, f in enumerate(files):
+                live = 0
+                for slot, val in f.value.items():
+                    pend = [r for r in reads[d].get((val[1], val[2]), [])
+                            if r >= start]
+                    f.reads_left[slot] = pend
+                    if pend:
+                        live += 1
+                f.live = live
+                f.live_peak = max(f.live_peak, live)
+
+    def finish(self, *, compression: bool = True) -> TableReport:
+        """Global (whole-table) checks + report assembly: unit counts vs
+        the action set validate_order demands, and (optionally) the
+        phase-compression roundtrip."""
+        cs, table, hazards = self.cs, self.table, self.hazards
+        T, S, M = self.T, self.S, self.M
+        activity = table_unit_activity(table).sum(axis=(0, 1))
+        n_f, n_b, n_w = int(activity[0]), int(activity[1]), int(activity[2])
+        want_f = S * M
+        want_b = (S - 1) * M if cs.split_backward else S * M
+        want_w = S * M if cs.split_backward else 0
+        for label, got, want, col in (("F", n_f, want_f, COL_FWD_M),
+                                      ("B", n_b, want_b, COL_BWD_M),
+                                      ("W", n_w, want_w, COL_W_M)):
+            if got != want:
+                hazards.append(Hazard(
+                    "unit-count", -1, -1, COLUMN_NAMES[col],
+                    f"{label} unit count {got} != expected {want} "
+                    f"(S={S}, M={M}, split_backward={cs.split_backward})"))
+        unit_counts = {"F": n_f, "B": n_b, "W": n_w, "idle": int(activity[3])}
+
+        # phase-compression roundtrip (compress self-checks; assert anyway)
+        comp: Dict[str, int] = {}
+        if compression:
+            try:
+                phases = compress_schedule(table)
+                if not np.array_equal(replay_phases(phases), table):
+                    raise ScheduleError("replay does not reconstruct the table")
+                spans = phase_spans(phases)
+                if sum(n for _, n in spans) != T:
+                    raise ScheduleError("phase spans do not tile the table")
+                comp = {"n_phases": len(phases), "n_rows": T}
+            except ScheduleError as e:
+                hazards.append(Hazard("compression-roundtrip", -1, -1,
+                                      "table", str(e)))
+
+        return TableReport(
+            name=cs.name, kind="train", n_devices=self.D,
+            n_virtual=cs.n_virtual, n_microbatches=M, placement=self.pl,
+            split_backward=cs.split_backward, makespan=T, hazards=hazards,
+            act_slots_used=[a.max_slot + 1 for a in self.act],
+            grad_slots_used=[g.max_slot + 1 for g in self.grad],
+            act_live_peak=[a.live_peak for a in self.act],
+            grad_live_peak=[g.live_peak for g in self.grad],
+            n_act_slots=cs.n_act_slots, n_grad_slots=cs.n_grad_slots,
+            comm=_comm_volume(table), unit_counts=unit_counts,
+            compression=comp)
+
+
+def check_table(cs: CompiledSchedule) -> TableReport:
+    """Statically verify a compiled training schedule's tick table.
+
+    Interprets the executor contract cell by cell (arrival stores, then
+    F/B/W units, then routed sends), accumulating every violation as a
+    located :class:`Hazard` instead of raising — see the module docstring
+    for the full check list."""
+    interp = _TrainInterp(cs)
+    for t in range(interp.T):
+        interp.run_tick(t)
+    return interp.finish()
+
+
+# ---------------------------------------------------------------------------
+# Search-loop fast path: digest memoization + incremental suffix recheck
+# ---------------------------------------------------------------------------
+
+_REPORT_MEMO: "OrderedDict[Tuple, TableReport]" = OrderedDict()
+_REPORT_MEMO_MAX = 256
+
+
+def _memo_key(cs: CompiledSchedule) -> Tuple:
+    from ..parallel.schedules import table_digest
+    return (table_digest(cs.table), cs.n_devices, cs.n_virtual,
+            cs.n_microbatches, cs.placement, bool(cs.split_backward),
+            cs.n_act_slots, cs.n_grad_slots)
+
+
+def check_table_cached(cs: CompiledSchedule) -> TableReport:
+    """:func:`check_table` memoized by table content digest + compile
+    metadata (LRU, bounded). The returned report is shared across hits —
+    treat it as immutable."""
+    key = _memo_key(cs)
+    hit = _REPORT_MEMO.get(key)
+    if hit is not None:
+        _REPORT_MEMO.move_to_end(key)
+        return hit
+    report = check_table(cs)
+    _REPORT_MEMO[key] = report
+    while len(_REPORT_MEMO) > _REPORT_MEMO_MAX:
+        _REPORT_MEMO.popitem(last=False)
+    return report
+
+
+@dataclasses.dataclass
+class TableCheckBaseline:
+    """Full check of one table plus per-tick interpreter snapshots, the
+    anchor :func:`recheck_after_swap` resumes from."""
+
+    cs: CompiledSchedule
+    table: np.ndarray
+    report: TableReport
+    snapshots: List[Tuple]  # snapshots[t] = state before run_tick(t)
+    write_log: List[Tuple]  # every buffer write: (t, d, label, slot, ...)
+
+
+def check_table_baseline(cs: CompiledSchedule) -> TableCheckBaseline:
+    """Run the full :func:`check_table` pass, keeping a state snapshot
+    before every tick so nearby candidate tables can be rechecked from the
+    first tick that differs instead of from tick 0."""
+    interp = _TrainInterp(cs)
+    log: List[Tuple] = []
+    for f in interp.act + interp.grad:
+        f.log = log
+    snaps: List[Tuple] = []
+    for t in range(interp.T):
+        snaps.append(interp.snapshot())
+        interp.run_tick(t)
+    report = interp.finish()
+    return TableCheckBaseline(cs=cs, table=interp.table.copy(),
+                              report=report, snapshots=snaps,
+                              write_log=log)
+
+
+# Hazard kinds at tick ``start`` that the resumed interpretation cannot
+# re-derive (they were emitted by run_tick(start - 1)'s pairing stage
+# against the unchanged row at ``start - 1``) — reused from the baseline.
+_PAIRING_KINDS = ("send-unpaired", "recv-unpaired")
+
+
+def recheck_after_swap(cs_new: CompiledSchedule,
+                       baseline: TableCheckBaseline) -> TableReport:
+    """Incrementally recheck ``cs_new`` against a clean baseline.
+
+    Finds the first tick where the new table differs from the baseline's,
+    restores the interpreter snapshot one tick earlier (pairing checks look
+    one row ahead), repoints slot liveness at the new table's read
+    schedule, and interprets only the suffix. Falls back to the full
+    :func:`check_table` when the baseline has hazards or the compile
+    metadata differs. Equivalent to the full check for hazard locations,
+    slot high-water marks, unit counts, and comm volume (tested over a
+    random-mutation corpus); the phase-compression roundtrip is skipped
+    (``compression == {}``) and prefix live peaks are inherited from the
+    baseline.
+    """
+    base_cs = baseline.cs
+    if (cs_new.n_devices != base_cs.n_devices
+            or cs_new.n_virtual != base_cs.n_virtual
+            or cs_new.n_microbatches != base_cs.n_microbatches
+            or cs_new.placement != base_cs.placement
+            or bool(cs_new.split_backward) != bool(base_cs.split_backward)
+            or cs_new.n_act_slots < base_cs.n_act_slots
+            or cs_new.n_grad_slots < base_cs.n_grad_slots
+            or not baseline.report.ok):
+        return check_table(cs_new)
+    new = np.asarray(cs_new.table)
+    old = baseline.table
+    k = min(new.shape[0], old.shape[0])
+    diff = np.nonzero((new[:k] != old[:k]).any(axis=(1, 2)))[0]
+    if diff.size == 0:
+        if (new.shape[0] == old.shape[0]
+                and cs_new.n_act_slots == base_cs.n_act_slots
+                and cs_new.n_grad_slots == base_cs.n_grad_slots):
+            return baseline.report  # identical table
+        t0 = k
+    else:
+        t0 = int(diff[0])
+    start = max(0, t0 - 1)
+    interp = _TrainInterp(cs_new)
+    interp.restore(baseline.snapshots[start])
+    interp.repatch_reads(start)
+    for t in range(start, interp.T):
+        interp.run_tick(t)
+    report = interp.finish(compression=False)
+    if start > 0:
+        # Prefix hazards carry over verbatim (rows < start are identical;
+        # pairing hazards AT start were emitted by run_tick(start - 1))...
+        prefix = [h for h in baseline.report.hazards
+                  if 0 <= h.tick < start
+                  or (h.tick == start and h.kind in _PAIRING_KINDS)]
+        # ...except WAR liveness: the read schedule is derived from the
+        # whole table, so a changed suffix can retroactively make a prefix
+        # overwrite hit a still-live value. Claims below t0 are identical
+        # (identical rows), so for the clean baseline a prefix write over
+        # resident value P becomes overwrite-live iff P's *new* claim list
+        # has reads >= t0.
+        for (u, d, label, slot, column, prev_val, _pending) in \
+                baseline.write_log:
+            if u >= start or prev_val is None:
+                continue
+            reads = (interp.act_reads if label == "act_buf"
+                     else interp.grad_reads)
+            tail = [r for r in reads[d].get((prev_val[1], prev_val[2]), [])
+                    if r >= t0]
+            if tail:
+                prefix.append(Hazard(
+                    "overwrite-live", d, u, COLUMN_NAMES[column],
+                    f"{label} slot {slot} overwritten while {prev_val} "
+                    f"still has reads at ticks {tail}"))
+        report.hazards[:0] = prefix
+    return report
 
 
 def check_forward_table(table: np.ndarray, n_devices: int, n_virtual: int,

@@ -153,7 +153,7 @@ def _compile(name: str, D: int, V: int, M: int) -> CompiledSchedule:
     (``analysis.table_check``) before it reaches the executor."""
     from ..analysis import maybe_verify_schedule
     from . import native
-    from .schedules import is_custom
+    from .schedules import is_custom, verify_artifact_pin
     if is_custom(name) or name == "ZBV":
         # custom orders are Python functions; ZBV's order is synthesized by
         # a Python greedy simulation the C++ engine does not mirror
@@ -171,6 +171,10 @@ def _compile(name: str, D: int, V: int, M: int) -> CompiledSchedule:
             pass  # fall through to the Python reference implementation
     if cs is None:
         cs = compile_schedule(name, D, V, M)
+    # Artifact-backed names always take the is_custom path above (their
+    # order fns are Python), but re-check the pin here too so a native
+    # table can never shadow a certified artifact name.
+    verify_artifact_pin(cs)
     maybe_verify_schedule(cs)
     return cs
 

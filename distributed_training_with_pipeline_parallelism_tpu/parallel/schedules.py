@@ -30,8 +30,10 @@ model, not just an analysis.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import heapq
-from typing import Dict, List, Optional, Sequence, Tuple
+import json
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -72,6 +74,7 @@ def register_schedule(name: str, order_fn, split_backward: bool = False,
 
 def unregister_schedule(name: str) -> None:
     _CUSTOM_SCHEDULES.pop(name, None)
+    _ARTIFACT_PINS.pop(name, None)
 
 
 def is_split_backward(name: str) -> bool:
@@ -693,6 +696,29 @@ def compile_schedule(name: str, n_devices: int, n_virtual: int,
     split = is_split_backward(name)
     placement = schedule_placement(name)
     orders = build_order(name, D, V, M)
+    cs = compile_order(name, orders, D, V, M, split_backward=split,
+                       placement=placement)
+    verify_artifact_pin(cs)
+    return cs
+
+
+def compile_order(name: str, orders: List[List[Action]], n_devices: int,
+                  n_virtual: int, n_microbatches: int, *,
+                  split_backward: bool = False, placement: str = "wrap",
+                  verify: bool = True) -> CompiledSchedule:
+    """Lower explicit per-device action orders to a verified tick table.
+
+    This is :func:`compile_schedule` minus the order *generation* step: the
+    caller supplies the per-device :class:`Action` lists directly, which is
+    what the schedule-search pass (``analysis.schedule_search``) and the
+    artifact loader need — both own their orders and must compile thousands
+    of candidate permutations without registering each one. ``verify=False``
+    skips the :func:`verify_table` self-check (the search certifies
+    candidates with the richer ``analysis.check_table`` instead); validation
+    of the action set and deadlock-freedom always runs.
+    """
+    D, V, M = n_devices, n_virtual, n_microbatches
+    split = split_backward
     validate_order(orders, D, V, M, split_backward=split,
                    placement=placement)
     ticks, T_compute = schedule_ticks(orders, D, V, placement=placement)
@@ -795,7 +821,8 @@ def compile_schedule(name: str, n_devices: int, n_virtual: int,
         T -= 1
     cs = CompiledSchedule(name, D, V, M, table[:T], T, ticks, n_act, n_grad,
                           split_backward=split, placement=placement)
-    verify_table(cs)
+    if verify:
+        verify_table(cs)
     return cs
 
 
@@ -924,6 +951,392 @@ def verify_table(cs: CompiledSchedule) -> None:
         ok = fwd_done == want and bwd_done == want and not w_done
     if not ok:
         raise ScheduleError("table does not execute every (stage, microbatch)")
+
+
+# ---------------------------------------------------------------------------
+# Schedule artifacts: certified, versioned JSON interchange for searched
+# (or otherwise externally produced) schedules. An artifact carries the
+# per-device action orders, the compiled [T, D, 17] table, a config
+# fingerprint over its metadata, and (when emitted by the search) the
+# embedded TableReport summary plus predicted cost. Loading recompiles the
+# orders and certifies the stored table cell-by-cell, so a tampered or
+# stale artifact fails with an exact (device, tick, column) location.
+# ---------------------------------------------------------------------------
+
+SCHEDULE_ARTIFACT_VERSION = 1
+SCHEDULE_ARTIFACT_KIND = "schedule_artifact"
+
+# Artifact-backed registered schedules: name -> pin. compile_schedule and
+# pipeline._compile re-check the pin (verify_artifact_pin) so a re-registered
+# order function can never silently swap a certified table.
+_ARTIFACT_PINS: Dict[str, Dict[str, str]] = {}
+
+
+def table_digest(table: np.ndarray) -> str:
+    """Content digest of a tick table (shape + little-endian int32 cells)."""
+    arr = np.ascontiguousarray(np.asarray(table, dtype="<i4"))
+    h = hashlib.sha256()
+    h.update(repr(arr.shape).encode())
+    h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+_FINGERPRINT_FIELDS = (
+    "artifact_version", "kind", "name", "n_devices", "n_virtual",
+    "n_microbatches", "placement", "split_backward", "n_act_slots",
+    "n_grad_slots", "makespan", "verifier_version", "table_digest")
+
+
+def _artifact_fingerprint(art: Dict[str, object]) -> str:
+    payload = {k: art.get(k) for k in _FINGERPRINT_FIELDS}
+    blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _orders_from_ticks(cs: CompiledSchedule) -> List[List[Action]]:
+    """Recover per-device action orders from a Python-compiled schedule's
+    tick assignment (one compute action per device per tick)."""
+    if not cs.ticks:
+        raise ScheduleError(
+            f"schedule {cs.name!r} has no tick map (natively compiled?); "
+            "cannot recover per-device orders for an artifact")
+    orders: List[List[Action]] = [[] for _ in range(cs.n_devices)]
+    key = lambda kv: (kv[1], kv[0].stage, kv[0].op, kv[0].microbatch)
+    for a, _t in sorted(cs.ticks.items(), key=key):
+        orders[placement_device_of(cs.placement, a.stage, cs.n_devices)].append(a)
+    return orders
+
+
+def schedule_artifact(cs: CompiledSchedule, *,
+                      orders: Optional[List[List[Action]]] = None,
+                      seed: Optional[int] = None,
+                      table_report: Optional[Dict[str, object]] = None,
+                      predicted: Optional[Dict[str, object]] = None,
+                      baselines: Optional[Dict[str, object]] = None,
+                      search: Optional[Dict[str, object]] = None) -> Dict[str, object]:
+    """Build the versioned JSON-serializable artifact for ``cs``.
+
+    ``table_report`` is a ``TableReport.summary()`` dict (the caller runs
+    ``check_table`` — this module stays import-clean of ``analysis``);
+    ``predicted`` is the cost-model dict; both are embedded verbatim.
+    The ``config_fingerprint`` signs the metadata fields only — table cells
+    are covered separately by ``table_digest`` plus the loader's
+    recompile-and-diff, which reports the exact mutated cell.
+    """
+    if orders is None:
+        orders = _orders_from_ticks(cs)
+    art: Dict[str, object] = {
+        "artifact_version": SCHEDULE_ARTIFACT_VERSION,
+        "kind": SCHEDULE_ARTIFACT_KIND,
+        "name": cs.name,
+        "n_devices": int(cs.n_devices),
+        "n_virtual": int(cs.n_virtual),
+        "n_microbatches": int(cs.n_microbatches),
+        "placement": cs.placement,
+        "split_backward": bool(cs.split_backward),
+        "n_act_slots": int(cs.n_act_slots),
+        "n_grad_slots": int(cs.n_grad_slots),
+        "makespan": int(cs.makespan),
+        "orders": [[[int(a.stage), a.op, int(a.microbatch)] for a in order]
+                   for order in orders],
+        "table": np.asarray(cs.table, dtype=np.int32).tolist(),
+        "table_digest": table_digest(cs.table),
+    }
+    from ..analysis import VERIFIER_VERSION  # lazy: analysis imports us
+    art["verifier_version"] = VERIFIER_VERSION
+    if seed is not None:
+        art["seed"] = int(seed)
+    if table_report is not None:
+        art["table_report"] = table_report
+    if predicted is not None:
+        art["predicted"] = predicted
+    if baselines is not None:
+        art["baselines"] = baselines
+    if search is not None:
+        art["search"] = search
+    art["config_fingerprint"] = _artifact_fingerprint(art)
+    return art
+
+
+def schedule_artifact_bytes(art: Dict[str, object]) -> bytes:
+    """Canonical (byte-deterministic) JSON encoding of an artifact."""
+    return (json.dumps(art, sort_keys=True) + "\n").encode()
+
+
+def save_schedule_artifact(art: Dict[str, object], path) -> None:
+    with open(path, "wb") as fh:
+        fh.write(schedule_artifact_bytes(art))
+
+
+def _art_err(label: str, field: str, msg: str) -> ScheduleError:
+    return ScheduleError(f"schedule artifact {label}: field {field!r}: {msg}")
+
+
+def _load_artifact_dict(source) -> Tuple[Dict[str, object], str]:
+    if isinstance(source, dict):
+        return source, "<dict>"
+    label = str(source)
+    try:
+        with open(source, "r", encoding="utf-8") as fh:
+            art = json.load(fh)
+    except OSError as e:
+        raise ScheduleError(f"schedule artifact {label}: unreadable: {e}")
+    except json.JSONDecodeError as e:
+        raise ScheduleError(f"schedule artifact {label}: invalid JSON: {e}")
+    if not isinstance(art, dict):
+        raise ScheduleError(
+            f"schedule artifact {label}: top level must be a JSON object, "
+            f"got {type(art).__name__}")
+    return art, label
+
+
+def _validated_int(art: Dict[str, object], label: str, key: str,
+                   minimum: int) -> int:
+    v = art.get(key)
+    if not isinstance(v, int) or isinstance(v, bool) or v < minimum:
+        raise _art_err(label, key, f"must be an int >= {minimum}, got {v!r}")
+    return v
+
+
+def _load_schedule_artifact_impl(source, verify: bool,
+                                 ) -> Tuple[CompiledSchedule, Dict[str, object],
+                                            List[List[Action]], str]:
+    art, label = _load_artifact_dict(source)
+    # --- schema: every mismatch is a located ScheduleError, never a numpy
+    # broadcasting error (tested with truncated columns / float cells).
+    ver = art.get("artifact_version")
+    if ver != SCHEDULE_ARTIFACT_VERSION:
+        raise _art_err(label, "artifact_version",
+                       f"unsupported version {ver!r} "
+                       f"(this build reads {SCHEDULE_ARTIFACT_VERSION})")
+    if art.get("kind") != SCHEDULE_ARTIFACT_KIND:
+        raise _art_err(label, "kind",
+                       f"expected {SCHEDULE_ARTIFACT_KIND!r}, got "
+                       f"{art.get('kind')!r}")
+    name = art.get("name")
+    if not isinstance(name, str) or not name:
+        raise _art_err(label, "name", f"must be a non-empty string, got {name!r}")
+    D = _validated_int(art, label, "n_devices", 1)
+    V = _validated_int(art, label, "n_virtual", 1)
+    M = _validated_int(art, label, "n_microbatches", 1)
+    n_act = _validated_int(art, label, "n_act_slots", 1)
+    n_grad = _validated_int(art, label, "n_grad_slots", 1)
+    makespan = _validated_int(art, label, "makespan", 1)
+    placement = art.get("placement")
+    if placement not in ("wrap", "vshape"):
+        raise _art_err(label, "placement",
+                       f"must be 'wrap' or 'vshape', got {placement!r}")
+    split = art.get("split_backward")
+    if not isinstance(split, bool):
+        raise _art_err(label, "split_backward", f"must be a bool, got {split!r}")
+    if not isinstance(art.get("table_digest"), str):
+        raise _art_err(label, "table_digest", "must be a hex string")
+    # --- stale-fingerprint check over the metadata fields, before any
+    # numpy work: an edited field (say n_microbatches) fails here.
+    fp = art.get("config_fingerprint")
+    want_fp = _artifact_fingerprint(art)
+    if fp != want_fp:
+        raise _art_err(
+            label, "config_fingerprint",
+            "stale fingerprint: metadata was edited after the artifact was "
+            f"signed (stored {str(fp)[:12]!r}, recomputed {want_fp[:12]!r})")
+    # --- table structure: shape / dtype / column count.
+    raw = art.get("table")
+    if not isinstance(raw, list) or not raw:
+        raise _art_err(label, "table",
+                       f"must be a non-empty [T][D][{N_COLS}] nested list")
+    try:
+        arr = np.asarray(raw)
+    except Exception as e:  # ragged nesting
+        raise _art_err(label, "table", f"not a rectangular array: {e}")
+    if arr.dtype == object or arr.ndim != 3:
+        raise _art_err(label, "table",
+                       f"must be rank-3 [T, D, {N_COLS}], got shape "
+                       f"{arr.shape} ({arr.dtype})")
+    if not np.issubdtype(arr.dtype, np.integer):
+        raise _art_err(label, "table",
+                       f"dtype mismatch: cells must be integers, got {arr.dtype}")
+    if arr.shape[2] != N_COLS:
+        raise _art_err(label, "table",
+                       f"column-count mismatch: {arr.shape[2]} columns != "
+                       f"N_COLS {N_COLS}")
+    if arr.shape[1] != D:
+        raise _art_err(label, "table",
+                       f"shape mismatch: {arr.shape[1]} device rows != "
+                       f"n_devices {D}")
+    if arr.shape[0] != makespan:
+        raise _art_err(label, "table",
+                       f"shape mismatch: {arr.shape[0]} ticks != makespan "
+                       f"{makespan}")
+    if (arr < -1).any():
+        t, d, c = (int(x) for x in np.argwhere(arr < -1)[0])
+        raise _art_err(label, "table",
+                       f"cell (device {d}, tick {t}, col {c}) = "
+                       f"{int(arr[t, d, c])} is below -1")
+    table = arr.astype(np.int32)
+    # --- orders.
+    raw_orders = art.get("orders")
+    if not isinstance(raw_orders, list) or len(raw_orders) != D:
+        raise _art_err(label, "orders",
+                       f"must be a list of {D} per-device action lists, got "
+                       f"{type(raw_orders).__name__} of length "
+                       f"{len(raw_orders) if isinstance(raw_orders, list) else '?'}")
+    orders: List[List[Action]] = []
+    for d, dev in enumerate(raw_orders):
+        if not isinstance(dev, list):
+            raise _art_err(label, f"orders[{d}]", "must be a list")
+        out: List[Action] = []
+        for i, item in enumerate(dev):
+            if (not isinstance(item, (list, tuple)) or len(item) != 3
+                    or not isinstance(item[0], int) or isinstance(item[0], bool)
+                    or item[1] not in (F, B, W)
+                    or not isinstance(item[2], int) or isinstance(item[2], bool)):
+                raise _art_err(label, f"orders[{d}][{i}]",
+                               f"must be [stage:int, op in 'FBW', mb:int], "
+                               f"got {item!r}")
+            out.append(Action(int(item[0]), str(item[1]), int(item[2])))
+        orders.append(out)
+    # --- recompile the orders (the authoritative source) and certify the
+    # stored table against the result, cell by cell.
+    try:
+        cs = compile_order(name, orders, D, V, M, split_backward=split,
+                           placement=placement)
+    except ScheduleError as e:
+        raise ScheduleError(
+            f"schedule artifact {label}: orders do not compile: {e}")
+    if cs.n_act_slots != n_act:
+        raise _art_err(label, "n_act_slots",
+                       f"{n_act} != recompiled {cs.n_act_slots}")
+    if cs.n_grad_slots != n_grad:
+        raise _art_err(label, "n_grad_slots",
+                       f"{n_grad} != recompiled {cs.n_grad_slots}")
+    if cs.table.shape != table.shape or not np.array_equal(cs.table, table):
+        k = min(cs.table.shape[0], table.shape[0])
+        diff = np.argwhere(cs.table[:k] != table[:k])
+        if diff.size:
+            t, d, c = (int(x) for x in diff[0])
+            col = _column_label(c)
+            raise ScheduleError(
+                f"schedule artifact {label}: certification failed at "
+                f"(device {d}, tick {t}, {col}): stored cell "
+                f"{int(table[t, d, c])} != certified value "
+                f"{int(cs.table[t, d, c])} (table tampered or stale)")
+        raise _art_err(label, "table",
+                       f"tick count {table.shape[0]} != recompiled "
+                       f"{cs.table.shape[0]}")
+    if art["table_digest"] != table_digest(table):
+        raise _art_err(label, "table_digest",
+                       "digest does not match the stored table")
+    # --- full static certification (and embedded-report consistency).
+    if verify:
+        from ..analysis.table_check import check_table
+        report = check_table(cs)
+        if report.hazards:
+            h = report.hazards[0]
+            raise ScheduleError(
+                f"schedule artifact {label}: certification failed: {h}")
+        emb = art.get("table_report")
+        if emb is not None:
+            if not isinstance(emb, dict):
+                raise _art_err(label, "table_report", "must be an object")
+            if emb.get("ok") is False or emb.get("n_hazards", 0):
+                raise _art_err(label, "table_report",
+                               "embeds a non-clean TableReport; refusing to "
+                               "load an uncertified artifact")
+            summary = report.summary()
+            for key in ("makespan", "predicted_ppermutes"):
+                if key in emb and emb[key] != summary[key]:
+                    raise _art_err(label, f"table_report.{key}",
+                                   f"{emb[key]!r} != recomputed "
+                                   f"{summary[key]!r}")
+    else:
+        from ..analysis import maybe_verify_schedule  # DTPP_VERIFY_TABLES hook
+        maybe_verify_schedule(cs)
+    return cs, art, orders, label
+
+
+def _column_label(c: int) -> str:
+    try:
+        from ..analysis.table_check import COLUMN_NAMES
+        return COLUMN_NAMES.get(c, f"col {c}")
+    except Exception:
+        return f"col {c}"
+
+
+def load_schedule_artifact(source, *, verify: bool = True) -> CompiledSchedule:
+    """Load a schedule artifact (path or dict) into a CompiledSchedule.
+
+    Validation order: JSON/schema (shape, dtype, column count) → metadata
+    ``config_fingerprint`` → recompile-from-orders diff (any mutated table
+    cell fails with its exact (device, tick, column)) → ``check_table``
+    certification. Every failure is a located :class:`ScheduleError` naming
+    the artifact and field. With ``verify=False`` the full ``check_table``
+    pass is skipped but the structural checks still run and
+    ``DTPP_VERIFY_TABLES`` re-verifies via the build-time hook.
+    """
+    cs, _art, _orders, _label = _load_schedule_artifact_impl(source, verify)
+    return cs
+
+
+def register_schedule_artifact(source, *, name: Optional[str] = None,
+                               overwrite: bool = True) -> CompiledSchedule:
+    """Load, certify, and register an artifact as a named schedule.
+
+    After this, ``compile_schedule(name, D, V, M)`` (and therefore
+    ``ScheduleConfig``/fit/sweep/bench) resolves the searched schedule like
+    any built-in — but pinned: the compile path re-checks the table digest
+    against the artifact, so the certified table cannot drift.
+    """
+    cs, art, orders, label = _load_schedule_artifact_impl(source, True)
+    reg_name = name if name is not None else cs.name
+    if cs.placement != "wrap":
+        raise ScheduleError(
+            f"schedule artifact {label}: only wrap-placement artifacts can "
+            "be registered (vshape placement is reserved for the ZBV builtin)")
+
+    def order_fn(D: int, V: int, M: int) -> List[List[Action]]:
+        want = (cs.n_devices, cs.n_virtual, cs.n_microbatches)
+        if (D, V, M) != want:
+            raise ScheduleError(
+                f"schedule {reg_name!r} was certified for n_devices={want[0]}, "
+                f"n_virtual={want[1]}, n_microbatches={want[2]}; requested "
+                f"({D}, {V}, {M}) — re-run the search for this config")
+        return [list(order) for order in orders]
+
+    register_schedule(reg_name, order_fn, split_backward=cs.split_backward,
+                      overwrite=overwrite)
+    _ARTIFACT_PINS[reg_name] = {
+        "table_digest": str(art["table_digest"]),
+        "config_fingerprint": str(art["config_fingerprint"]),
+        "source": label,
+    }
+    if reg_name != cs.name:
+        cs = dataclasses.replace(cs, name=reg_name)
+    return cs
+
+
+def registered_artifact_info(name: str) -> Optional[Dict[str, str]]:
+    """Pin metadata (table digest / fingerprint / source) for an
+    artifact-backed schedule name, or None."""
+    info = _ARTIFACT_PINS.get(name)
+    return dict(info) if info is not None else None
+
+
+def verify_artifact_pin(cs: CompiledSchedule) -> None:
+    """For artifact-backed schedule names, re-check the compiled table's
+    digest against the certified pin. Called on every compile/ingest path
+    so a re-registered order function (or a mutated registry) can never
+    swap in an uncertified table under a certified name."""
+    pin = _ARTIFACT_PINS.get(cs.name)
+    if pin is None:
+        return
+    got = table_digest(cs.table)
+    if got != pin["table_digest"]:
+        raise ScheduleError(
+            f"schedule {cs.name!r}: compiled table digest {got[:12]}... does "
+            f"not match the certified artifact pin "
+            f"{pin['table_digest'][:12]}... (source {pin['source']}) — the "
+            "registered orders no longer produce the certified table")
 
 
 # ---------------------------------------------------------------------------

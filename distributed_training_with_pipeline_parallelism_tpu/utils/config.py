@@ -221,6 +221,22 @@ class ScheduleConfig:
     def __post_init__(self):
         _check_schedule_name(self.name)
 
+    @classmethod
+    def from_artifact(cls, source, *, name: Optional[str] = None
+                      ) -> "ScheduleConfig":
+        """Register a certified schedule artifact (a path or parsed dict
+        from ``analysis.schedule_search`` / ``scripts/search_schedule.py``)
+        and return the :class:`ScheduleConfig` that selects it.
+
+        The artifact is fully re-certified on load (recompile + cell diff
+        + ``check_table``) and pinned, so ``fit``/``sweep``/``bench`` runs
+        under the returned config execute exactly the certified table —
+        see ``parallel.schedules.register_schedule_artifact``."""
+        from ..parallel.schedules import register_schedule_artifact
+        cs = register_schedule_artifact(source, name=name)
+        return cls(name=cs.name, n_microbatches=cs.n_microbatches,
+                   n_virtual=cs.n_virtual)
+
 
 # The single source of builtin names is the schedule module; re-exported here
 # because config is the user-facing surface (CLIs use it for --schedule).

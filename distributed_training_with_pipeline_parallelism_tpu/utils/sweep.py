@@ -42,7 +42,9 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                        dtype: str = "float32",
                        remat_backward=None,
                        unroll_ticks=None,
-                       report_dir: Optional[str] = None) -> Dict[str, float]:
+                       report_dir: Optional[str] = None,
+                       schedule_artifact: Optional[str] = None
+                       ) -> Dict[str, float]:
     """Run one pipeline experiment; returns the reference's metrics dict plus
     bubble analytics, or ``{"error": ...}`` on failure.
 
@@ -64,7 +66,14 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
     every "parallel" tick serializes, wall-clock measures total work plus
     per-tick overhead, and the throughput columns must NOT be read as
     pipeline-overlap measurements (schedule-ordering claims come from the
-    bubble/cost-model columns; docs/results.md §2)."""
+    bubble/cost-model columns; docs/results.md §2).
+
+    ``schedule_artifact``: path to a certified schedule artifact
+    (``scripts/search_schedule.py``). It is registered and re-certified
+    on load, and overrides ``schedule_type``/``n_microbatches``/the
+    virtual-stage rule with the artifact's own certified config, so a
+    searched schedule is a first-class sweep row (the row records the
+    pinned table digest in ``schedule_artifact_digest``)."""
     import jax
 
     from ..models.transformer import transformer_init
@@ -74,7 +83,18 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                                       compile_schedule, simulated_bubble)
 
     try:
-        n_virtual = virtual_stages_for(schedule_type, n_layers, num_devices)
+        artifact_info = None
+        if schedule_artifact is not None:
+            from ..parallel.schedules import (register_schedule_artifact,
+                                              registered_artifact_info)
+            cs_art = register_schedule_artifact(schedule_artifact)
+            schedule_type = cs_art.name
+            n_microbatches = cs_art.n_microbatches
+            n_virtual = cs_art.n_virtual
+            artifact_info = registered_artifact_info(schedule_type)
+        else:
+            n_virtual = virtual_stages_for(schedule_type, n_layers,
+                                           num_devices)
         if schedule_type == "ZBV":
             # ZBV's steady state needs M >= 2D; lift the reference's fixed 4
             # where required (recorded in the row's n_microbatches column)
@@ -99,10 +119,13 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
             from .telemetry import RunReport
             report = RunReport(name=f"sweep_L{n_layers}_H{n_heads}_"
                                     f"D{num_devices}_{schedule_type}")
+            meta_extra = ({"schedule_artifact": artifact_info}
+                          if artifact_info else {})
             report.set_meta(config=cfg, schedule=sched,
                             mesh_shape=dict(mesh.shape),
                             batch_size=batch_size, seq_length=seq_length,
-                            backend=jax.devices()[0].platform)
+                            backend=jax.devices()[0].platform,
+                            **meta_extra)
         metrics = run_train_iterations(step, params, tokens, targets,
                                        num_iterations=num_iterations,
                                        report=report)
@@ -149,6 +172,9 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                 else ("unrolled" if cs.table.shape[0] <= 64 else "phases")),
             "host_serialized": jax.devices()[0].platform == "cpu",
         })
+        if artifact_info is not None:
+            metrics["schedule_artifact_digest"] = \
+                artifact_info["table_digest"]
         if report is not None:
             import json
             import os

@@ -398,11 +398,18 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
     if report_dir is not None:
         from .telemetry import RunReport
         report = RunReport(out_dir=report_dir, name="fit")
+        # artifact-backed schedules record their certification pin (table
+        # digest + fingerprint + source) so the manifest names exactly
+        # which certified table the run executed
+        from ..parallel.schedules import registered_artifact_info
+        art_info = registered_artifact_info(sched.name)
         report.set_meta(config=dataclasses.asdict(cfg),
                         schedule=dataclasses.asdict(sched),
                         mesh_shape=dict(mesh.shape),
                         num_steps=num_steps, grad_accum=grad_accum,
-                        backend=jax.devices()[0].platform)
+                        backend=jax.devices()[0].platform,
+                        **({"schedule_artifact": art_info}
+                           if art_info else {}))
     if fsdp and zero1:
         raise ValueError("fsdp already shards optimizer state (ZeRO-3 "
                          "subsumes ZeRO-1) — drop --zero1")

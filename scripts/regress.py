@@ -46,7 +46,29 @@ def _get(d, *path):
 
 
 def extract_metrics(manifest) -> dict:
-    """One history row from a RunReport manifest (missing metrics -> None)."""
+    """One history row from a RunReport manifest (missing metrics -> None).
+
+    Also accepts a certified schedule artifact (``kind ==
+    "schedule_artifact"``, from ``scripts/search_schedule.py``): its
+    predicted cost becomes the row, so searched schedules accumulate the
+    same regression history as measured runs (backend ``"static"`` — no
+    execution happened)."""
+    if manifest.get("kind") == "schedule_artifact":
+        pred = manifest.get("predicted") or {}
+        return {
+            "t": time.time(),
+            "name": "schedule_search",
+            "backend": "static",
+            "schedule": "{}[D={},V={},M={}]".format(
+                manifest.get("name", "Searched"),
+                manifest.get("n_devices"), manifest.get("n_virtual"),
+                manifest.get("n_microbatches")),
+            "tokens_per_sec": None,
+            "mfu": None,
+            "bubble": pred.get("bubble_table_exact"),
+            "predicted_step_s": pred.get("step_s"),
+            "measured_step_s": None,
+        }
     gauges = manifest.get("gauges") or {}
     cm = manifest.get("cost_model")
     tokens_per_sec = None

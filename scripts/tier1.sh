@@ -46,6 +46,26 @@ if ! timeout -k 10 60 \
   exit 1
 fi
 echo "REGRESS=ok"
+# Certifying schedule compiler next (pure numpy, no jax backend): a
+# seeded search must emit a certified artifact that beats 1F1B's
+# table-exact bubble at D=4/M=8, survive its own certifying reload, and
+# be byte-deterministic. The artifact lands in /tmp/search_smoke for CI
+# upload and its predicted cost feeds the same regression history as
+# measured runs (warn-only — docs/static_analysis.md "Schedule
+# compiler").
+if ! timeout -k 10 120 \
+    python scripts/search_schedule.py /tmp/search_smoke --require-beat; then
+  echo "SEARCH_SMOKE=fail"
+  exit 1
+fi
+if ! timeout -k 10 60 \
+    python scripts/regress.py \
+    --report /tmp/search_smoke/searched_schedule.json \
+    --history results/history.jsonl --warn-only; then
+  echo "SEARCH_SMOKE=fail"
+  exit 1
+fi
+echo "SEARCH_SMOKE=ok"
 # Serving liveness next (same discipline): a small continuous-batching
 # run must bit-match the single-device oracle and produce a validated
 # report with TTFT/TPOT rows. Lands in /tmp/serve_smoke for CI upload.

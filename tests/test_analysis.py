@@ -520,6 +520,120 @@ def test_lint_flags_bare_jit_in_parallel():
     assert lint_source("x.py", src_ok, package_relpath="parallel/x.py") == []
 
 
+def test_lint_flags_raw_tick_table_construction():
+    src = ("import numpy as np\n"
+           "from distributed_training_with_pipeline_parallelism_tpu.parallel"
+           ".schedules import N_COLS, COL_FWD_V\n"
+           "table = np.full((4, 2, N_COLS), -1, np.int32)\n"
+           "table[0, 0, COL_FWD_V] = 1\n")
+    findings = lint_source("x.py", src, package_relpath="parallel/x.py")
+    assert [f.rule for f in findings] == ["raw-tick-table"] * 2
+    assert {f.line for f in findings} == {3, 4}
+
+
+def test_lint_flags_tick_table_at_update():
+    src = ("import jax.numpy as jnp\n"
+           "def f(table, COL_BWD_V):\n"
+           "    return table.at[0, 0, COL_BWD_V].set(2)\n")
+    findings = lint_source("x.py", src, package_relpath="utils/x.py")
+    assert [f.rule for f in findings] == ["raw-tick-table"]
+
+
+def test_lint_raw_tick_table_reads_and_allowlist_stay_legal():
+    # column *reads* are the executor idiom and stay legal everywhere
+    src_read = ("def f(row, COL_FWD_V):\n"
+                "    return row[COL_FWD_V]\n")
+    assert lint_source("x.py", src_read,
+                       package_relpath="parallel/x.py") == []
+    # the schedule compiler itself (and analysis/) keep write access
+    src_write = ("import numpy as np\n"
+                 "N_COLS = 17\n"
+                 "table = np.full((4, 2, N_COLS), -1, np.int32)\n")
+    assert lint_source("x.py", src_write,
+                       package_relpath="parallel/schedules.py") == []
+    assert lint_source("x.py", src_write,
+                       package_relpath="analysis/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# check_table fast path: digest memoization + incremental suffix recheck
+# ---------------------------------------------------------------------------
+
+
+def test_check_table_cached_shares_report():
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.table_check import (
+        check_table_cached)
+    a = check_table_cached(compile_schedule("ZBH1", 4, 1, 8))
+    b = check_table_cached(compile_schedule("ZBH1", 4, 1, 8))
+    assert a is b  # digest + metadata hit
+    assert a.ok
+
+
+def test_recheck_after_swap_identical_table_returns_baseline():
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.table_check import (
+        check_table_baseline, recheck_after_swap)
+    cs = compile_schedule("ZBH1", 4, 1, 8)
+    baseline = check_table_baseline(cs)
+    assert recheck_after_swap(compile_schedule("ZBH1", 4, 1, 8),
+                              baseline) is baseline.report
+
+
+def test_recheck_after_swap_matches_full_check():
+    """Equivalence on a deterministic mutation corpus: the incremental
+    recheck must report the same hazard locations, unit counts, and
+    predicted collective count as the from-scratch pass — including
+    suffix mutations whose WAR liveness retroactively extends into the
+    unchanged prefix (the write-log reconciliation path)."""
+    import random
+
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.table_check import (
+        check_table_baseline, recheck_after_swap)
+
+    def key(report):
+        return sorted((h.kind, h.device, h.tick, h.column)
+                      for h in report.hazards)
+
+    for name, D, V, M in [("ZBH1", 4, 1, 8), ("1F1B", 4, 1, 8),
+                          ("ZBV", 2, 2, 4)]:
+        cs = compile_schedule(name, D, V, M)
+        baseline = check_table_baseline(cs)
+        assert baseline.report.ok
+        rng = random.Random(0)
+        T = cs.table.shape[0]
+        for _ in range(20):
+            t = rng.randrange(T // 2, T)  # suffix mutations: the fast path
+            d = rng.randrange(D)
+            c = rng.randrange(cs.table.shape[2])
+            delta = rng.choice([-1, 1, 2])
+            new = max(-1, int(cs.table[t, d, c]) + delta)
+            if new == cs.table[t, d, c]:
+                continue
+            bad = _mutated(cs, lambda tb: tb.__setitem__((t, d, c), new))
+            inc = recheck_after_swap(bad, baseline)
+            full = check_table(bad)
+            assert key(inc) == key(full), (name, t, d, c, new)
+            assert inc.unit_counts == full.unit_counts
+            assert inc.predicted_ppermutes == full.predicted_ppermutes
+            if full.ok:
+                assert inc.act_slots_used == full.act_slots_used
+                assert inc.grad_slots_used == full.grad_slots_used
+
+
+def test_recheck_after_swap_falls_back_on_metadata_change():
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.table_check import (
+        check_table_baseline, recheck_after_swap)
+    baseline = check_table_baseline(compile_schedule("1F1B", 4, 1, 8))
+    other = compile_schedule("1F1B", 4, 1, 4)  # different M: full check
+    report = recheck_after_swap(other, baseline)
+    assert key_equal(report, check_table(other))
+
+
+def key_equal(a, b):
+    ka = sorted((h.kind, h.device, h.tick, h.column) for h in a.hazards)
+    kb = sorted((h.kind, h.device, h.tick, h.column) for h in b.hazards)
+    return ka == kb and a.unit_counts == b.unit_counts
+
+
 # ---------------------------------------------------------------------------
 # satellite 6: RunReport static_analysis section
 # ---------------------------------------------------------------------------
