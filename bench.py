@@ -231,6 +231,35 @@ def _cost_model(cfg, batch_size, seq_length, n_pipe, headline,
         measured_step_s=headline["elapsed_s"] / max(num_iterations, 1))
 
 
+def _memory_model(cfg, batch_size, seq_length, n_pipe, n_microbatches=4,
+                  schedule="GPipe") -> dict:
+    """Bytes-domain section for a bench config (analysis.memory_model):
+    analytic per-device HBM from the verifier's slot peaks — attached to
+    the RunReport manifest, consulted by the rung OOM preflight, and
+    guarded by scripts/regress.py."""
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.memory_model import (
+        memory_model_section)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules import (
+        compile_schedule)
+    cs = compile_schedule(schedule, n_pipe, 1, n_microbatches)
+    return memory_model_section(cs, cfg, batch_size=batch_size,
+                                seq_length=seq_length)
+
+
+def _rung_preflight(cfg, batch_size, seq_length, n_pipe,
+                    n_microbatches) -> dict:
+    """Price a rung before compiling it. Returns the ``oom_preflight``
+    verdict ({"ok": True} on any pricing failure — the preflight must
+    never veto a rung it could not price)."""
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.memory_model import (
+        oom_preflight)
+    try:
+        return oom_preflight(_memory_model(cfg, batch_size, seq_length,
+                                           n_pipe, n_microbatches))
+    except Exception:  # pragma: no cover - pricing must not veto rungs
+        return {"ok": True}
+
+
 def _result(headline, extra, n_pipe) -> dict:
     """Assemble the printed JSON line + the embedded RunReport manifest
     (same schema as sweep rows and ``fit`` reports — utils.telemetry)."""
@@ -246,8 +275,11 @@ def _result(headline, extra, n_pipe) -> dict:
     cm = extra.get("cost_model")
     if isinstance(cm, dict) and "schedule" in cm:  # not an error stub
         report.attach_cost_model(cm)
+    mem = extra.get("memory")
+    if isinstance(mem, dict) and "analytic" in mem:  # not an error stub
+        report.attach_memory(mem)
     for key, row in extra.items():
-        if isinstance(row, dict) and key != "cost_model":
+        if isinstance(row, dict) and key not in ("cost_model", "memory"):
             report.event("rung", name=key, **row)
     manifest = report.manifest()
     validate_report(manifest)
@@ -293,8 +325,12 @@ def run(num_iterations: int = 20) -> dict:
                                      min(num_iterations, 2))
         except Exception as e:  # pragma: no cover - never blocks the row
             cost_model = {"error": str(e)}
+        try:
+            memory = _memory_model(proxy_cfg, 8, 64, n_pipe)
+        except Exception as e:  # pragma: no cover - never blocks the row
+            memory = {"error": str(e)}
         extra = {"headline": headline, "n_devices": n_pipe,
-                 "cost_model": cost_model, **backend,
+                 "cost_model": cost_model, "memory": memory, **backend,
                  "headline_proxy": "cpu fallback proxy: ref_decoder L4/H8 "
                                    "float32, batch 8, seq 64, 2 iterations "
                                    "— NOT comparable to the baseline",
@@ -321,6 +357,10 @@ def run(num_iterations: int = 20) -> dict:
                                           headline, num_iterations)
     except Exception as e:  # pragma: no cover - never blocks the headline
         extra["cost_model"] = {"error": str(e)}
+    try:
+        extra["memory"] = _memory_model(ref_cfg, 32, 128, n_pipe)
+    except Exception as e:  # pragma: no cover - never blocks the headline
+        extra["memory"] = {"error": str(e)}
     # secondary configs are isolated: one config's failure (e.g. a device
     # count that does not divide a model's layer count) must not discard
     # the headline result — the reference's own sweep-error contract
@@ -406,16 +446,25 @@ def run(num_iterations: int = 20) -> dict:
          2, 1, 8192, "gpt2_small_seq8192_bs2"),
     ]
     for rung_cfg, batch, n_mb, seq, key in rungs:
-        if rung_cfg.n_layers % n_pipe == 0:
-            try:
-                extra[key] = run_config(rung_cfg, batch, seq,
-                                        num_iterations, n_microbatches=n_mb,
-                                        n_pipe=n_pipe)
-            except Exception as e:  # pragma: no cover - hardware-dependent
-                extra[key] = {"error": str(e)}
-        else:
+        if rung_cfg.n_layers % n_pipe != 0:
             extra[key] = {"skipped": f"{n_pipe} devices do not divide "
                                      f"{rung_cfg.n_layers} layers"}
+            continue
+        # OOM preflight: a rung the memory model prices over the chip's
+        # HBM becomes a labelled skip row instead of a mid-bench crash
+        pf = _rung_preflight(rung_cfg, batch, seq, n_pipe, n_mb)
+        if not pf["ok"]:
+            extra[key] = {
+                "skipped": "predicted_oom",
+                "predicted_peak_bytes": pf["predicted_peak_bytes"],
+                "hbm_bytes": pf["hbm_bytes"]}
+            continue
+        try:
+            extra[key] = run_config(rung_cfg, batch, seq,
+                                    num_iterations, n_microbatches=n_mb,
+                                    n_pipe=n_pipe)
+        except Exception as e:  # pragma: no cover - hardware-dependent
+            extra[key] = {"error": str(e)}
     return _result(headline, extra, n_pipe)
 
 

@@ -21,6 +21,12 @@ distributed_training_with_pipeline_parallelism_tpu.analysis``):
   bubble fractions, MFU/HFU from measured step time) — the predicted
   side of the predicted↔measured loop ``utils.telemetry`` closes
   (docs/observability.md "Cost model & MFU").
+- :mod:`.memory_model` — the bytes-domain twin of the cost model:
+  per-device HBM priced three ways (analytic slot-peaks x slot-bytes +
+  params/optimizer/KV, AOT-compiled ``memory_analysis()``, live
+  ``memory_stats()`` watermarks) and reconciled; source of the
+  sweep/bench OOM preflight and the byte-denominated search budgets
+  (docs/observability.md "Memory observatory").
 - :mod:`.schedule_search` — the certifying schedule compiler: seeded,
   deterministic search over per-device action orders whose objective is
   the cost model's predicted step time and whose hard constraints are
@@ -123,6 +129,13 @@ _LAZY = {
     "resolve_backward_policy": ("cost_model", "resolve_backward_policy"),
     "backward_weights": ("cost_model", "backward_weights"),
     "predicted_step_time": ("cost_model", "predicted_step_time"),
+    "memory_model_section": ("memory_model", "memory_model_section"),
+    "serving_memory_section": ("memory_model", "serving_memory_section"),
+    "activation_slot_bytes": ("memory_model", "activation_slot_bytes"),
+    "params_bytes": ("memory_model", "params_bytes"),
+    "compiled_memory_section": ("memory_model", "compiled_memory_section"),
+    "reconcile_memory": ("memory_model", "reconcile_memory"),
+    "oom_preflight": ("memory_model", "oom_preflight"),
     "SearchSpec": ("schedule_search", "SearchSpec"),
     "SearchResult": ("schedule_search", "SearchResult"),
     "search_schedule": ("schedule_search", "search_schedule"),

@@ -4,9 +4,11 @@
 the forward-only table and the serving ring; ``--lint`` runs the repo
 lint; ``--jaxpr`` traces small train/serving step functions on a
 simulated mesh and audits them (needs a jax backend — the script wrapper
-sets up 8 fake CPU devices before any jax import); ``--all`` is all
-three. Exit code 0 iff every requested pass is clean. ``--json PATH``
-writes the full structured report (the CI artifact).
+sets up 8 fake CPU devices before any jax import); ``--memory`` prices
+per-device HBM over the same grid and pins the analytic-bytes identity
+(docs/observability.md "Memory observatory"); ``--all`` is every pass.
+Exit code 0 iff every requested pass is clean. ``--json PATH`` writes
+the full structured report (the CI artifact).
 """
 
 from __future__ import annotations
@@ -80,6 +82,91 @@ def run_table_checks(grid: Optional[List[GridEntry]] = None
         n_hazards += reports[-1]["n_hazards"]
     return {"n_checked": len(reports), "n_hazards": n_hazards,
             "ok": n_hazards == 0, "reports": reports}
+
+
+def run_memory_checks(grid: Optional[List[GridEntry]] = None
+                      ) -> Dict[str, Any]:
+    """The ``--memory`` pass: over the same schedule grid the table
+    verifier walks, build :func:`.memory_model.memory_model_section` and
+    assert the integer identity — per-device analytic activation/grad
+    bytes equal the verifier's slot live peaks times one slot's slab
+    bytes, exactly. Host-side only (``jax.eval_shape``): no backend, no
+    compiles."""
+    from ..parallel.schedules import ScheduleError, compile_schedule
+    from ..utils.config import ModelConfig
+    from .memory_model import memory_model_section
+    from .table_check import check_table
+
+    cfg = ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                      ffn_dim=64, max_seq_len=16)
+    batch, seq = 8, 16
+    reports: List[Dict[str, Any]] = []
+    n_bad = 0
+    for name, D, V, M in (grid if grid is not None else default_grid()):
+        row: Dict[str, Any] = {"name": name, "n_devices": D, "n_virtual": V,
+                               "n_microbatches": M}
+        try:
+            cs = compile_schedule(name, D, V, M)
+        except ScheduleError as e:
+            row.update(ok=False, error=f"compile failed: {e}")
+            reports.append(row)
+            n_bad += 1
+            continue
+        tr = check_table(cs)
+        sec = memory_model_section(cs, cfg, batch_size=batch,
+                                   seq_length=seq, table_report=tr)
+        slot_b = sec["analytic"]["act_slot_bytes"]
+        exact = all(
+            pd["act_bytes"] == tr.act_live_peak[pd["device"]] * slot_b
+            and pd["grad_bytes"] == tr.grad_live_peak[pd["device"]] * slot_b
+            for pd in sec["analytic"]["per_device"])
+        row.update(ok=bool(exact),
+                   act_slot_bytes=slot_b,
+                   backward_policy=sec["backward_policy"],
+                   peak_bytes=sec["analytic"]["peak_bytes"],
+                   per_device=sec["analytic"]["per_device"])
+        if not exact:
+            row["error"] = "analytic bytes != live_peak x slot_bytes"
+            n_bad += 1
+        reports.append(row)
+    # the remaining rows of the table pass's 44-entry grid: forward-only
+    # tables and the serving ring carry live peaks too — price them with
+    # the same identity (one [mb, seq, dim] / [1, C, dim] slab per slot)
+    from ..parallel.pipeline import _fwd_tick_table
+    from .memory_model import activation_slot_bytes
+    from .table_check import check_forward_table, check_serving_ring
+    for D, V, M in ((2, 1, 4), (4, 1, 8), (2, 2, 4)):
+        table, n_slots = _fwd_tick_table(D, V, M)
+        tr = check_forward_table(table, D, V, M, n_slots)
+        slot_b = activation_slot_bytes(cfg, batch, seq, M)
+        per_device = [{"device": d, "act_live_peak": int(p),
+                       "grad_live_peak": 0,
+                       "act_bytes": int(p) * slot_b, "grad_bytes": 0}
+                      for d, p in enumerate(tr.act_live_peak)]
+        reports.append({"name": "forward", "n_devices": D,
+                        "n_virtual": V, "n_microbatches": M, "ok": True,
+                        "act_slot_bytes": slot_b,
+                        "backward_policy": "none",
+                        "peak_bytes": float(max(pd["act_bytes"]
+                                                for pd in per_device)),
+                        "per_device": per_device})
+    from .cost_model import dtype_bytes
+    for D, M in ((2, 2), (4, 4), (4, 6)):
+        tr = check_serving_ring(D, M)
+        slot_b = cfg.dim * dtype_bytes(cfg.dtype)  # one decode token/slot
+        per_device = [{"device": d, "act_live_peak": int(p),
+                       "grad_live_peak": 0,
+                       "act_bytes": int(p) * slot_b, "grad_bytes": 0}
+                      for d, p in enumerate(tr.act_live_peak)]
+        reports.append({"name": "serving_ring", "n_devices": D,
+                        "n_virtual": 1, "n_microbatches": M, "ok": True,
+                        "act_slot_bytes": slot_b,
+                        "backward_policy": "none",
+                        "peak_bytes": float(max(pd["act_bytes"]
+                                                for pd in per_device)),
+                        "per_device": per_device})
+    return {"n_checked": len(reports), "n_bad": n_bad, "ok": n_bad == 0,
+            "batch_size": batch, "seq_length": seq, "reports": reports}
 
 
 def run_lint() -> Dict[str, Any]:
@@ -191,12 +278,16 @@ def run_search(out_path: Optional[str] = None, *, seed: int = 0,
 
 def run_checks(tables: bool = True, lint: bool = True,
                jaxpr: bool = False, search: bool = False,
-               search_out: Optional[str] = None) -> Dict[str, Any]:
+               search_out: Optional[str] = None,
+               memory: bool = False) -> Dict[str, Any]:
     report: Dict[str, Any] = {"verifier_version": VERIFIER_VERSION}
     ok = True
     if tables:
         report["tables"] = run_table_checks()
         ok = ok and report["tables"]["ok"]
+    if memory:
+        report["memory"] = run_memory_checks()
+        ok = ok and report["memory"]["ok"]
     if lint:
         report["lint"] = run_lint()
         ok = ok and report["lint"]["ok"]
@@ -230,6 +321,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--search-out", metavar="PATH",
                     help="with --search: save the first winner's schedule "
                          "artifact JSON to PATH")
+    ap.add_argument("--memory", action="store_true",
+                    help="price per-device HBM over the schedule grid and "
+                         "pin analytic bytes == slot live peaks x slot "
+                         "bytes (host-side, no backend)")
     ap.add_argument("--all", action="store_true", help="all three passes")
     ap.add_argument("--json", metavar="PATH",
                     help="write the structured report to PATH")
@@ -241,11 +336,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     lint = args.lint or args.all
     jaxpr = args.jaxpr or args.all
     search = args.search or args.all
-    if not (tables or lint or jaxpr or search):
+    memory = args.memory or args.all
+    if not (tables or lint or jaxpr or search or memory):
         tables = lint = True  # cheap default: no jax import needed
 
     report = run_checks(tables=tables, lint=lint, jaxpr=jaxpr,
-                        search=search, search_out=args.search_out)
+                        search=search, search_out=args.search_out,
+                        memory=memory)
 
     if not args.quiet:
         if "tables" in report:
@@ -255,6 +352,25 @@ def main(argv: Optional[List[str]] = None) -> int:
             for r in t["reports"]:
                 for h in r.get("hazards", []):
                     print(f"  {r.get('name')}: {h}")
+        if "memory" in report:
+            m = report["memory"]
+            print(f"memory: {m['n_checked']} priced, {m['n_bad']} identity "
+                  f"violations (batch={m['batch_size']}, "
+                  f"seq={m['seq_length']})")
+            for r in m["reports"]:
+                if "error" in r:
+                    print(f"  {r['name']}[D={r['n_devices']},"
+                          f"V={r['n_virtual']},M={r['n_microbatches']}]: "
+                          f"{r['error']}")
+                    continue
+                cells = " ".join(
+                    f"d{pd['device']}:{pd['act_live_peak']}x"
+                    f"{r['act_slot_bytes']}B+{pd['grad_live_peak']}g"
+                    for pd in r["per_device"])
+                print(f"  {r['name']}[D={r['n_devices']},"
+                      f"V={r['n_virtual']},M={r['n_microbatches']}] "
+                      f"{r['backward_policy']}: "
+                      f"peak {r['peak_bytes'] / 1e6:.3f} MB  {cells}")
         if "lint" in report:
             li = report["lint"]
             print(f"lint: {li['n_findings']} findings")

@@ -76,17 +76,21 @@ class HardwareSpec:
     ``bench.chip_peak_flops`` divides MFU by — kept equal by test).
     ``ici_bytes_per_s``: usable unidirectional bandwidth of the one ICI
     link a ring hop crosses. ``hbm_bytes_per_s``: per-chip HBM bandwidth
-    (the second roofline ceiling, reported for context). ``cpu_proxy``:
-    the numbers are order-of-magnitude placeholders for a simulated-CPU
-    host — predictions keep their *structure* (relative schedule ranking,
-    bubble fractions are hardware-free) but absolute seconds are not
-    accelerator claims, and downstream consumers (regress.py) treat the
-    run as warn-only."""
+    (the second roofline ceiling, reported for context). ``hbm_bytes``:
+    per-chip HBM *capacity* — the denominator of
+    ``analysis.memory_model``'s OOM preflight and the unit byte-valued
+    ``schedule_search`` budgets are quoted in (0.0 = unknown, preflight
+    disabled). ``cpu_proxy``: the numbers are order-of-magnitude
+    placeholders for a simulated-CPU host — predictions keep their
+    *structure* (relative schedule ranking, bubble fractions are
+    hardware-free) but absolute seconds are not accelerator claims, and
+    downstream consumers (regress.py) treat the run as warn-only."""
 
     name: str
     peak_flops: float
     ici_bytes_per_s: float
     hbm_bytes_per_s: float
+    hbm_bytes: float = 0.0
     cpu_proxy: bool = False
 
     def summary(self) -> Dict[str, Any]:
@@ -97,17 +101,21 @@ class HardwareSpec:
 # TOPS). ICI: one link of v4/v5e 3D/2D torus ~45-50 GB/s usable each
 # way; v5p ~100 GB/s; v6e ~90 GB/s. HBM: v5e 819 GB/s (the number
 # profile_breakdown.py's roofline uses), v4 1228, v5p 2765, v6e 1640.
+# Capacity: v5e/v6e 16 GiB-class (16e9), v4 32, v5p 95.
 TPU_PRESETS: Dict[str, HardwareSpec] = {
-    "v5 lite": HardwareSpec("v5e", 197e12, 5.0e10, 8.19e11),
-    "v5e": HardwareSpec("v5e", 197e12, 5.0e10, 8.19e11),
-    "v5p": HardwareSpec("v5p", 459e12, 1.0e11, 2.765e12),
-    "v4": HardwareSpec("v4", 275e12, 5.0e10, 1.228e12),
-    "v6": HardwareSpec("v6e", 918e12, 9.0e10, 1.64e12),
+    "v5 lite": HardwareSpec("v5e", 197e12, 5.0e10, 8.19e11, 16e9),
+    "v5e": HardwareSpec("v5e", 197e12, 5.0e10, 8.19e11, 16e9),
+    "v5p": HardwareSpec("v5p", 459e12, 1.0e11, 2.765e12, 95e9),
+    "v4": HardwareSpec("v4", 275e12, 5.0e10, 1.228e12, 32e9),
+    "v6": HardwareSpec("v6e", 918e12, 9.0e10, 1.64e12, 32e9),
 }
 
 # One host CPU core-ish matmul throughput and loopback "interconnect":
-# honest only about orders of magnitude, flagged cpu_proxy=True.
-CPU_PROXY = HardwareSpec("cpu_proxy", 5e10, 1e9, 5e10, cpu_proxy=True)
+# honest only about orders of magnitude, flagged cpu_proxy=True. The
+# 16e9 "HBM" stands in for a host-RAM slice so the memory-model OOM
+# preflight stays exercisable (and testable) on the simulated mesh.
+CPU_PROXY = HardwareSpec("cpu_proxy", 5e10, 1e9, 5e10, 16e9,
+                         cpu_proxy=True)
 
 
 def hardware_spec_for(device_kind: str) -> HardwareSpec:

@@ -87,6 +87,14 @@ class SearchSpec:
     high-water marks (``TableReport.act_slots_used`` /
     ``grad_slots_used``); candidates over budget are rejected as hard
     constraint violations, same as hazards.
+
+    Budgets can also be stated in *bytes* of HBM:
+    ``act_bytes_budget``/``grad_bytes_budget`` (e.g. a fraction of
+    :attr:`.cost_model.HardwareSpec.hbm_bytes` left after parameters and
+    optimizer state) are divided by ``act_slot_bytes``/``grad_slot_bytes``
+    (one slot's slab size, :func:`.memory_model.activation_slot_bytes`)
+    and floored into an equivalent slot cap; when both a slot and a byte
+    budget are given the tighter one wins (:meth:`resolved_slot_budgets`).
     """
 
     n_devices: int
@@ -100,6 +108,10 @@ class SearchSpec:
     hop_s: float = 0.0
     act_slot_budget: Optional[int] = None
     grad_slot_budget: Optional[int] = None
+    act_bytes_budget: Optional[float] = None
+    grad_bytes_budget: Optional[float] = None
+    act_slot_bytes: Optional[int] = None
+    grad_slot_bytes: Optional[int] = None
     name: str = "Searched"
 
     def resolved_unit_s(self) -> Tuple[float, float, float]:
@@ -114,6 +126,20 @@ class SearchSpec:
             policy = "remat"
         b, w = backward_weights(policy)
         return (1.0, float(b), float(w))
+
+    def resolved_slot_budgets(self) -> Tuple[Optional[int], Optional[int]]:
+        """Effective (act, grad) per-device slot caps: the tighter of the
+        slot-count budget and ``floor(bytes_budget / slot_bytes)``."""
+        def tighter(slots: Optional[int], bytes_budget: Optional[float],
+                    slot_bytes: Optional[int]) -> Optional[int]:
+            caps = [] if slots is None else [int(slots)]
+            if bytes_budget is not None:
+                caps.append(int(float(bytes_budget) // int(slot_bytes)))
+            return min(caps) if caps else None
+        return (tighter(self.act_slot_budget, self.act_bytes_budget,
+                        self.act_slot_bytes),
+                tighter(self.grad_slot_budget, self.grad_bytes_budget,
+                        self.grad_slot_bytes))
 
     def validate(self) -> None:
         if self.n_devices < 1:
@@ -134,6 +160,19 @@ class SearchSpec:
                                 "(the ZB-V executor contract)")
         if self.iterations < 0:
             raise ScheduleError(f"iterations must be >= 0, got {self.iterations}")
+        for kind in ("act", "grad"):
+            bytes_budget = getattr(self, f"{kind}_bytes_budget")
+            slot_bytes = getattr(self, f"{kind}_slot_bytes")
+            if bytes_budget is not None:
+                if slot_bytes is None or slot_bytes <= 0:
+                    raise ScheduleError(
+                        f"{kind}_bytes_budget needs {kind}_slot_bytes > 0 to "
+                        f"convert bytes into slots (use analysis.memory_model"
+                        f".activation_slot_bytes), got {slot_bytes!r}")
+                if bytes_budget < slot_bytes:
+                    raise ScheduleError(
+                        f"{kind}_bytes_budget={bytes_budget} holds zero slots "
+                        f"of {slot_bytes} bytes — no schedule can fit")
 
 
 @dataclasses.dataclass
@@ -302,12 +341,13 @@ def _evaluate(spec: SearchSpec, orders: List[List[Action]],
     if report.hazards:
         stats["rejected_hazards"] += 1
         return None
-    if (spec.act_slot_budget is not None
-            and max(report.act_slots_used, default=0) > spec.act_slot_budget):
+    act_cap, grad_cap = spec.resolved_slot_budgets()
+    if (act_cap is not None
+            and max(report.act_slots_used, default=0) > act_cap):
         stats["rejected_budget"] += 1
         return None
-    if (spec.grad_slot_budget is not None
-            and max(report.grad_slots_used, default=0) > spec.grad_slot_budget):
+    if (grad_cap is not None
+            and max(report.grad_slots_used, default=0) > grad_cap):
         stats["rejected_budget"] += 1
         return None
     predicted = predicted_step_time(cs.table, unit_s, spec.hop_s,
@@ -430,6 +470,12 @@ def search_schedule(spec: SearchSpec) -> SearchResult:
         "hop_s": spec.hop_s,
         "act_slot_budget": spec.act_slot_budget,
         "grad_slot_budget": spec.grad_slot_budget,
+        "act_bytes_budget": spec.act_bytes_budget,
+        "grad_bytes_budget": spec.grad_bytes_budget,
+        "act_slot_bytes": spec.act_slot_bytes,
+        "grad_slot_bytes": spec.grad_slot_bytes,
+        "effective_act_slot_budget": spec.resolved_slot_budgets()[0],
+        "effective_grad_slot_budget": spec.resolved_slot_budgets()[1],
         "objective": "predicted_step_time.step_s",
         **stats,
     }

@@ -39,7 +39,7 @@ program over a ``Mesh(('data', 'pipe'))``:
 from __future__ import annotations
 
 import logging
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -1618,6 +1618,34 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel,
         fsdp=fsdp, remat_backward=remat_backward, unroll_ticks=unroll_ticks,
         telemetry=telemetry))
+
+
+def aot_memory_analysis(step, *args) -> Dict[str, Any]:
+    """XLA's memory accounting for a jitted step, ahead of time.
+
+    ``lower(*args).compile()`` the step (the compile cache makes this
+    free when the step already ran) and extract
+    ``compiled.memory_analysis()``'s byte counters — the *compiled*
+    accounting ``analysis.memory_model`` reconciles against its analytic
+    slot model. Sizes are per addressable shard: a pipe-sharded
+    parameter tree counts as layers/D plus the replicated operands per
+    device (the reconciliation pin relies on this). Degrades to
+    ``{"error": ...}`` on backends whose runtime exposes no memory
+    analysis rather than failing the run."""
+    try:
+        compiled = step.lower(*args).compile()
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return {"error": "memory_analysis unavailable on this backend"}
+        return {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+            "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+        }
+    except Exception as e:  # AOT paths vary by backend/jax version
+        return {"error": str(e)}
 
 
 def fsdp_shard_params(params: Pytree, cfg: ModelConfig, mesh: Mesh,

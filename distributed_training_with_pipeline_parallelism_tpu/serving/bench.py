@@ -121,6 +121,17 @@ def run_serve_bench(*, cfg: Optional[ModelConfig] = None, params=None,
                 serving_summary(results["continuous"])))
         except Exception:  # pragma: no cover - accounting never fails a run
             pass
+        # bytes-domain twin: analytic KV-cache/params accounting plus
+        # XLA's own numbers for the already-compiled serving block
+        try:
+            from ..analysis.memory_model import serving_memory_section
+            from ..parallel.pipeline import aot_memory_analysis
+            report.attach_memory(serving_memory_section(
+                cfg, program,
+                compiled=aot_memory_analysis(
+                    program.step, *engine.weights, program.init_state())))
+        except Exception:  # pragma: no cover - accounting never fails a run
+            pass
 
     cont, stat = results["continuous"], results["static"]
     # same program + greedy: both policies must emit identical tokens per

@@ -43,7 +43,8 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                        remat_backward=None,
                        unroll_ticks=None,
                        report_dir: Optional[str] = None,
-                       schedule_artifact: Optional[str] = None
+                       schedule_artifact: Optional[str] = None,
+                       oom_preflight: bool = True
                        ) -> Dict[str, float]:
     """Run one pipeline experiment; returns the reference's metrics dict plus
     bubble analytics, or ``{"error": ...}`` on failure.
@@ -73,7 +74,13 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
     on load, and overrides ``schedule_type``/``n_microbatches``/the
     virtual-stage rule with the artifact's own certified config, so a
     searched schedule is a first-class sweep row (the row records the
-    pinned table digest in ``schedule_artifact_digest``)."""
+    pinned table digest in ``schedule_artifact_digest``).
+
+    ``oom_preflight``: price the config with ``analysis.memory_model``
+    against the detected chip's HBM capacity BEFORE compiling anything;
+    a predicted overflow returns a ``skip_reason="predicted_oom"`` row
+    (with the predicted bytes) instead of crashing mid-sweep. Pass
+    ``False`` to force the compile anyway."""
     import jax
 
     from ..models.transformer import transformer_init
@@ -104,6 +111,25 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
         sched = ScheduleConfig(name=schedule_type,
                                n_microbatches=n_microbatches,
                                n_virtual=n_virtual)
+        cs = compile_schedule(schedule_type, num_devices, n_virtual,
+                              n_microbatches)
+        # OOM preflight: price the config BEFORE the (expensive, possibly
+        # fatal) compile; a predicted overflow becomes a skipped row
+        from ..analysis.memory_model import (memory_model_section,
+                                             oom_preflight as _preflight)
+        mem_section = memory_model_section(
+            cs, cfg, batch_size=batch_size, seq_length=seq_length,
+            remat_backward=remat_backward)
+        if oom_preflight:
+            pf = _preflight(mem_section)
+            if not pf["ok"]:
+                return {
+                    "skip_reason": "predicted_oom",
+                    "predicted_peak_bytes": pf["predicted_peak_bytes"],
+                    "hbm_bytes": pf["hbm_bytes"],
+                    "n_virtual": n_virtual,
+                    "n_microbatches": n_microbatches,
+                }
         mesh = make_mesh(n_pipe=num_devices)
         step = make_pipeline_step(cfg, mesh, sched,
                                   remat_backward=remat_backward,
@@ -129,8 +155,6 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
         metrics = run_train_iterations(step, params, tokens, targets,
                                        num_iterations=num_iterations,
                                        report=report)
-        cs = compile_schedule(schedule_type, num_devices, n_virtual,
-                              n_microbatches)
         # bubble_simulated uses the weights of the backward the executor
         # actually compiled, mirroring make_pipeline_grad_fn's resolution
         # (shared with the roofline in analysis.cost_model): stored
@@ -183,6 +207,14 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
             for k, v in metrics.items():
                 report.gauge(k, v)
             report.attach_cost_model(cost_model)
+            # bytes-domain section: the preflight's analytic model plus
+            # XLA's own accounting (free — the step is already compiled)
+            from ..parallel.pipeline import aot_memory_analysis
+            mem_section = memory_model_section(
+                cs, cfg, batch_size=batch_size, seq_length=seq_length,
+                remat_backward=remat_backward,
+                compiled=aot_memory_analysis(step, params, tokens, targets))
+            report.attach_memory(mem_section)
             manifest = report.manifest()
             validate_report(manifest)
             os.makedirs(report_dir, exist_ok=True)
@@ -218,6 +250,19 @@ def run_all_experiments(layers: Sequence[int] = (4, 8, 12),
         if "error" in result:
             if verbose:
                 print(f"    ERROR: {result['error']}", flush=True)
+            continue
+        if "skip_reason" in result:
+            # a priced-out config is a row, not a crash: the DataFrame
+            # records WHY it was skipped and how far over budget it was
+            if verbose:
+                print(f"    SKIPPED ({result['skip_reason']}): predicted "
+                      f"{result.get('predicted_peak_bytes', 0) / 1e9:.2f} GB "
+                      f"> {result.get('hbm_bytes', 0) / 1e9:.2f} GB HBM",
+                      flush=True)
+            rows.append({
+                "n_layers": L, "n_heads": H, "num_processes": D,
+                "schedule": s, **result,
+            })
             continue
         if verbose:
             print(f"    throughput: {result['throughput']:.2f} tokens/sec",

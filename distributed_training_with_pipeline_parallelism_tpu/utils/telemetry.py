@@ -107,6 +107,10 @@ class PipelineTelemetry:
         self.table: Optional[np.ndarray] = None
         self.phases = None  # Tuple[schedules.Phase, ...] | None
         self.executor: Optional[str] = None
+        # live HBM watermarks sampled at step boundaries (see _stamp);
+        # None = capability not probed yet, [] = backend has no stats
+        self.memory_samples: List[Dict[str, Any]] = []
+        self._mem_devices = None
 
     # -- build-time -----------------------------------------------------
 
@@ -129,12 +133,69 @@ class PipelineTelemetry:
     # -- run-time host target -------------------------------------------
 
     def _stamp(self, kind, index, _probe) -> None:
-        self.events.append((int(kind), int(index), time.perf_counter()))
+        k = int(kind)
+        t = time.perf_counter()
+        self.events.append((k, int(index), t))
+        if k in (STEP_START, STEP_END):
+            self._sample_memory(k, t)
+
+    def _sample_memory(self, kind: int, t: float) -> None:
+        """Record per-device ``memory_stats()`` watermarks at a step
+        boundary. Rides the *existing* stamp callback — telemetry-off
+        builds still trace zero host callbacks, and backends whose
+        devices return ``None`` (CPU) probe once then no-op forever."""
+        if self._mem_devices is None:
+            try:
+                import jax
+                self._mem_devices = [
+                    d for d in jax.devices()
+                    if isinstance(d.memory_stats(), dict)]
+            except Exception:
+                self._mem_devices = []
+        for dev in self._mem_devices:
+            try:
+                stats = dev.memory_stats()
+                in_use = int(stats.get("bytes_in_use", 0))
+                self.memory_samples.append({
+                    "kind": _KIND_NAMES.get(kind, str(kind)),
+                    "device": int(dev.id), "t": t,
+                    "bytes_in_use": in_use,
+                    "peak_bytes_in_use": int(
+                        stats.get("peak_bytes_in_use", in_use)),
+                })
+            except Exception:
+                pass
+
+    def memory_summary(self) -> Dict[str, Any]:
+        """The ``live`` subsection of the manifest's ``memory`` block:
+        per-device high-water marks over the recorded samples.
+        ``available=False`` (no per-device rows) on backends without
+        allocator stats — consumers must degrade, not assume."""
+        per_dev: Dict[int, Dict[str, int]] = {}
+        for s in self.memory_samples:
+            d = s["device"]
+            row = per_dev.setdefault(
+                d, {"device": d, "peak_bytes_in_use": 0,
+                    "last_bytes_in_use": 0, "n_samples": 0})
+            row["peak_bytes_in_use"] = max(row["peak_bytes_in_use"],
+                                           s["peak_bytes_in_use"],
+                                           s["bytes_in_use"])
+            row["last_bytes_in_use"] = s["bytes_in_use"]
+            row["n_samples"] += 1
+        rows = [per_dev[d] for d in sorted(per_dev)]
+        return {
+            "available": bool(rows),
+            "n_samples": len(self.memory_samples),
+            "per_device": rows,
+            "peak_bytes_in_use": (max(r["peak_bytes_in_use"] for r in rows)
+                                  if rows else None),
+        }
 
     def reset(self) -> None:
         """Drop recorded events (keep the attached schedule) — call between
         steps when only the last step's timeline is wanted."""
         self.events = []
+        self.memory_samples = []
 
     # -- analysis -------------------------------------------------------
 
@@ -271,6 +332,8 @@ class PipelineTelemetry:
             out["timeline"] = self.timeline()
             if self.table is not None:
                 out["stage_breakdown"] = self.stage_breakdown()
+        if self.memory_samples:
+            out["memory_watermarks"] = self.memory_summary()
         return out
 
 
@@ -381,7 +444,9 @@ def critical_path(telemetry: PipelineTelemetry) -> Dict[str, Any]:
     }
 
 
-def perfetto_trace(telemetry: PipelineTelemetry) -> Dict[str, Any]:
+def perfetto_trace(telemetry: PipelineTelemetry,
+                   serving_events: Optional[List[Dict[str, Any]]] = None
+                   ) -> Dict[str, Any]:
     """The measured timeline as a Chrome-trace/Perfetto JSON object.
 
     One track (tid) per pipeline device under a single process, one
@@ -389,9 +454,15 @@ def perfetto_trace(telemetry: PipelineTelemetry) -> Dict[str, Any]:
     ``B v1 m2`` / ``W m0`` / ``idle``, categorized by kind — and one
     ``"s"``→``"f"`` flow pair per ring-hop store (cat ``ppermute``,
     anchored mid-slice on the sending and receiving ticks) so arrows in
-    the UI show exactly the hops the table predicts. Timestamps are
-    microseconds from the first stamp, sorted ascending; load the written
-    file in ui.perfetto.dev or chrome://tracing."""
+    the UI show exactly the hops the table predicts. When the telemetry
+    carries live watermark samples, each device additionally gets a
+    ``"C"`` counter track (``HBM bytes_in_use``) sampled at step
+    boundaries, drawn right next to the F/B/W slices. ``serving_events``:
+    RunReport event rows — ``serve_admit``/``serve_finish`` pairs become
+    async request slices on a separate "requests" process
+    (:func:`perfetto_request_events`). Timestamps are microseconds from
+    the first stamp, sorted ascending; load the written file in
+    ui.perfetto.dev or chrome://tracing."""
     from ..parallel.schedules import (COL_BWD_M, COL_BWD_V, COL_FWD_M,
                                       COL_FWD_V, COL_W_M, COL_W_V)
     if telemetry.table is None:
@@ -445,6 +516,21 @@ def perfetto_trace(telemetry: PipelineTelemetry) -> Dict[str, Any]:
                         "ph": "f", "bp": "e", "id": flow_id, "name": name,
                         "cat": "ppermute", "pid": 0, "tid": d,
                         "ts": (t0[t] + 0.5 * dur[t]) * us})
+    # live HBM counter track: one "C" event per (boundary sample, device),
+    # on the same clock as the stamps so the sawtooth lines up with ticks
+    n_counters = 0
+    if telemetry.memory_samples:
+        origin = min(t for _, _, t in telemetry.events)
+        for s in telemetry.memory_samples:
+            n_counters += 1
+            events.append({
+                "ph": "C", "name": f"HBM device {s['device']}",
+                "cat": "memory", "pid": 0, "tid": 0,
+                "ts": max(s["t"] - origin, 0.0) * us,
+                "args": {"bytes_in_use": s["bytes_in_use"],
+                         "peak_bytes_in_use": s["peak_bytes_in_use"]}})
+    if serving_events:
+        events.extend(perfetto_request_events(serving_events))
     # sorted ts is part of the format contract (and what the schema test
     # pins); metadata first among equals so track names land before slices
     events.sort(key=lambda e: (e["ts"], 0 if e["ph"] == "M" else 1))
@@ -452,13 +538,76 @@ def perfetto_trace(telemetry: PipelineTelemetry) -> Dict[str, Any]:
         "traceEvents": events,
         "displayTimeUnit": "ms",
         "otherData": {"executor": telemetry.executor, "n_devices": D,
-                      "n_ticks": T, "n_flows": flow_id},
+                      "n_ticks": T, "n_flows": flow_id,
+                      "n_memory_counters": n_counters},
     }
 
 
-def write_perfetto_trace(telemetry: PipelineTelemetry, path: str) -> str:
-    """Serialize :func:`perfetto_trace` to ``path``; returns the path."""
-    trace = perfetto_trace(telemetry)
+def perfetto_request_events(serving_events: List[Dict[str, Any]],
+                            pid: int = 1) -> List[Dict[str, Any]]:
+    """Per-request async slices from ``serve_admit``/``serve_finish``
+    RunReport event rows: one ``"b"``→``"e"`` pair per request id on a
+    "requests" process track, laid out on the events' wall clock
+    (normalized to the first admit). The slice args carry the on-device
+    tick stamps — ``admit_tick``, prompt length / budget from the admit
+    row, ``finish_tick``/``n_tokens``/``ttft_ticks`` from the finish row
+    — so a TTFT/TPOT outlier in the UI names the exact ticks to inspect
+    on the pipeline timeline. Slices land on a per-slot tid, so slot
+    reuse reads as a row of back-to-back requests."""
+    admits = {}
+    finishes = {}
+    for row in serving_events or []:
+        if row.get("kind") == "serve_admit" and "rid" in row:
+            admits[row["rid"]] = row
+        elif row.get("kind") == "serve_finish" and "rid" in row:
+            finishes[row["rid"]] = row
+    if not admits:
+        return []
+    us = 1e6
+    origin = min(r["t"] for r in admits.values())
+    out: List[Dict[str, Any]] = [{
+        "ph": "M", "name": "process_name", "pid": pid, "tid": 0, "ts": 0.0,
+        "args": {"name": "serving requests"}}]
+    slots = sorted({int(r.get("slot", 0)) for r in admits.values()})
+    for slot in slots:
+        out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": slot, "ts": 0.0,
+                    "args": {"name": f"slot {slot}"}})
+    for rid, adm in sorted(admits.items(), key=lambda kv: kv[1]["t"]):
+        fin = finishes.get(rid)
+        slot = int(adm.get("slot", 0))
+        ts = (adm["t"] - origin) * us
+        args = {"rid": rid, "slot": slot,
+                "admit_tick": adm.get("tick"),
+                "prompt_len": adm.get("prompt_len"),
+                "budget": adm.get("budget")}
+        if fin is not None:
+            args.update({"finish_tick": fin.get("tick"),
+                         "n_tokens": fin.get("n_tokens"),
+                         "ttft_ticks": fin.get("ttft_ticks")})
+        common = {"cat": "request", "id": int(rid), "name": f"req {rid}",
+                  "pid": pid, "tid": slot}
+        out.append({"ph": "b", "ts": ts, "args": args, **common})
+        # unfinished requests (failed / still in flight) close zero-width
+        end_ts = (fin["t"] - origin) * us if fin is not None else ts
+        out.append({"ph": "e", "ts": end_ts, "args": {}, **common})
+    return out
+
+
+def write_perfetto_trace(telemetry: Optional[PipelineTelemetry], path: str,
+                         serving_events: Optional[List[Dict[str, Any]]] = None
+                         ) -> str:
+    """Serialize :func:`perfetto_trace` to ``path``; returns the path.
+    With ``telemetry=None`` (a serving-only run has no pipeline
+    telemetry) the trace holds just the requests track."""
+    if telemetry is None:
+        trace: Dict[str, Any] = {
+            "traceEvents": perfetto_request_events(serving_events or []),
+            "displayTimeUnit": "ms",
+            "otherData": {"executor": "serving"},
+        }
+    else:
+        trace = perfetto_trace(telemetry, serving_events=serving_events)
     with open(path, "w") as fh:
         json.dump(trace, fh)
     return path
@@ -552,6 +701,7 @@ class RunReport:
         self.resilience: Optional[Dict[str, Any]] = None
         self.static_analysis: Optional[Dict[str, Any]] = None
         self.cost_model: Optional[Dict[str, Any]] = None
+        self.memory: Optional[Dict[str, Any]] = None
         self.out_dir = out_dir
         self._events_fh = None
         # the event stream is written from the training loop AND from
@@ -630,6 +780,16 @@ class RunReport:
         block — the record ``scripts/regress.py`` reads."""
         self.cost_model = dict(section)
 
+    def attach_memory(self, section: Dict[str, Any]) -> None:
+        """Embed the HBM accounting
+        (:func:`analysis.memory_model.memory_model_section` /
+        ``serving_memory_section``: analytic per-device bytes from the
+        verifier's slot peaks, AOT-compiled ``memory_analysis()``, live
+        watermark summary and their reconciliation) as the manifest's
+        ``memory`` block — the bytes-domain record ``scripts/regress.py``
+        guards."""
+        self.memory = dict(section)
+
     # -- output ---------------------------------------------------------
 
     def manifest(self) -> Dict[str, Any]:
@@ -655,6 +815,8 @@ class RunReport:
             out["static_analysis"] = _jsonable(self.static_analysis)
         if self.cost_model is not None:
             out["cost_model"] = _jsonable(self.cost_model)
+        if self.memory is not None:
+            out["memory"] = _jsonable(self.memory)
         return out
 
     def write(self, path: Optional[str] = None) -> Dict[str, Any]:
@@ -831,3 +993,45 @@ def validate_report(manifest: Dict[str, Any]) -> None:
             for key in ("compute_s", "comm_s", "bubble_s"):
                 if not isinstance(attrib.get(key), (int, float)):
                     fail(f"cost_model.attribution.{key} must be a number")
+    mem = manifest.get("memory")
+    if mem is not None:
+        if not isinstance(mem, dict):
+            fail("memory must be a dict")
+        if not isinstance(mem.get("schedule"), str):
+            fail("memory.schedule must be a string")
+        hw = mem.get("hardware")
+        if not isinstance(hw, dict) or not isinstance(hw.get("name"), str):
+            fail("memory.hardware needs a str name")
+        ana = mem.get("analytic")
+        if not isinstance(ana, dict):
+            fail("memory.analytic must be a dict")
+        for key in ("act_slot_bytes", "grad_slot_bytes", "peak_bytes",
+                    "params_per_device_bytes"):
+            if not isinstance(ana.get(key), (int, float)):
+                fail(f"memory.analytic.{key} must be a number")
+        devs = ana.get("per_device")
+        if not isinstance(devs, list) or not devs:
+            fail("memory.analytic.per_device must be a non-empty list")
+        for row in devs:
+            if not isinstance(row, dict) or not isinstance(
+                    row.get("device"), int):
+                fail("memory.analytic.per_device rows need an int 'device'")
+            for key in ("act_bytes", "grad_bytes", "total_bytes"):
+                if not isinstance(row.get(key), (int, float)):
+                    fail(f"memory.analytic.per_device.{key} must be a "
+                         "number")
+        comp = mem.get("compiled")
+        if comp is not None:
+            if not isinstance(comp, dict):
+                fail("memory.compiled must be a dict")
+            if "error" not in comp:
+                for key in ("argument_bytes", "output_bytes", "temp_bytes"):
+                    if not isinstance(comp.get(key), (int, float)):
+                        fail(f"memory.compiled.{key} must be a number")
+        live = mem.get("live")
+        if live is not None:
+            if not isinstance(live, dict) or not isinstance(
+                    live.get("available"), bool):
+                fail("memory.live needs a bool 'available'")
+            if not isinstance(live.get("per_device"), list):
+                fail("memory.live.per_device must be a list")

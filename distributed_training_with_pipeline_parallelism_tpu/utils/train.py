@@ -535,6 +535,23 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                             telemetry=telemetry))
                 except Exception as e:
                     report.event("cost_model_error", error=str(e))
+        if data_shape is not None:
+            # bytes-domain twin of the cost-model attach: analytic HBM
+            # from the verifier's slot peaks (+ AdamW's two fp32 moments)
+            # plus any live watermarks the stamps sampled — same
+            # never-take-down-the-run discipline
+            try:
+                from ..analysis.memory_model import memory_model_section
+                from ..parallel.schedules import compile_schedule
+                cs = compile_schedule(sched.name, mesh.shape["pipe"],
+                                      sched.n_virtual, sched.n_microbatches)
+                report.attach_memory(memory_model_section(
+                    cs, cfg, batch_size=data_shape[0],
+                    seq_length=data_shape[1],
+                    remat_backward=remat_backward,
+                    optimizer_slots=2, telemetry=telemetry))
+            except Exception as e:
+                report.event("memory_model_error", error=str(e))
         res = {}
         if mgr is not None:
             res.update(mgr.stats())

@@ -249,6 +249,73 @@ def cost_model_breakdown(cm: dict) -> None:
               f"{attr.get('straggler_stage', '?')}")
 
 
+def memory_breakdown(mem: dict) -> None:
+    """Print a manifest's ``memory`` section: the analytic per-device HBM
+    table, XLA's compiled accounting, the reconciliation verdict, and
+    live watermarks when the backend reported any
+    (analysis.memory_model; docs/observability.md "Memory observatory").
+    Degrades per-subsection — a section with only the analytic view
+    still renders."""
+    hw = mem.get("hardware") or {}
+    print(f"\n--- memory: {mem.get('schedule', '?')} "
+          f"D={mem.get('n_devices', '?')} V={mem.get('n_virtual', '?')} "
+          f"M={mem.get('n_microbatches', '?')} "
+          f"policy={mem.get('backward_policy', '?')} "
+          f"dtype={mem.get('dtype', '?')} on {hw.get('name', '?')} ---")
+
+    def _mb(v):
+        return f"{v / 1e6:.3f}" if isinstance(v, (int, float)) else "n/a"
+
+    ana = mem.get("analytic") or {}
+    print(f"slot {ana.get('act_slot_bytes', '?')} B, params/device "
+          f"{_mb(ana.get('params_per_device_bytes'))} MB, "
+          f"opt slots {ana.get('optimizer_slots', 0)}, "
+          f"peak {_mb(ana.get('peak_bytes'))} MB"
+          + (f" ({ana['hbm_frac']:.1%} of "
+             f"{_mb(hw.get('hbm_bytes'))} MB HBM)"
+             if isinstance(ana.get("hbm_frac"), (int, float)) else ""))
+    rows = ana.get("per_device") or []
+    if rows:
+        print(f"{'device':>6s} {'act pk':>6s} {'grad pk':>7s} "
+              f"{'act MB':>8s} {'grad MB':>8s} {'resid MB':>8s} "
+              f"{'total MB':>9s}")
+        for pd in rows:
+            print(f"{pd.get('device', -1):6d} "
+                  f"{pd.get('act_live_peak', 0):6d} "
+                  f"{pd.get('grad_live_peak', 0):7d} "
+                  f"{_mb(pd.get('act_bytes')):>8s} "
+                  f"{_mb(pd.get('grad_bytes')):>8s} "
+                  f"{_mb(pd.get('stored_residual_bytes', 0.0)):>8s} "
+                  f"{_mb(pd.get('total_bytes')):>9s}")
+    comp = mem.get("compiled")
+    if isinstance(comp, dict):
+        if "error" in comp:
+            print(f"compiled: unavailable ({comp['error']})")
+        else:
+            print(f"compiled (per shard): args {_mb(comp.get('argument_bytes'))}"
+                  f" MB, out {_mb(comp.get('output_bytes'))} MB, "
+                  f"temp {_mb(comp.get('temp_bytes'))} MB, "
+                  f"total {_mb(comp.get('total_bytes'))} MB")
+    rec = mem.get("reconciliation")
+    if isinstance(rec, dict) and "ok" in rec:
+        print(f"reconciliation: analytic args "
+              f"{_mb(rec.get('expected_argument_bytes'))} MB vs compiled "
+              f"{_mb(rec.get('compiled_argument_bytes'))} MB, rel err "
+              f"{rec.get('argument_rel_err', 0.0):.4f} "
+              f"({'OK' if rec.get('ok') else 'DRIFTED'} at "
+              f"{rec.get('tolerance', 0.0):.0%} tolerance)")
+    live = mem.get("live")
+    if isinstance(live, dict):
+        if not live.get("available"):
+            print("live watermarks: backend reports no memory_stats() "
+                  "(expected on CPU)")
+        else:
+            for pd in live.get("per_device") or []:
+                print(f"live device {pd.get('device', '?')}: peak "
+                      f"{_mb(pd.get('peak_bytes_in_use'))} MB over "
+                      f"{pd.get('n_samples', 0)} samples")
+
+
 def report_breakdown(manifest: dict) -> None:
     """Print the telemetry + cost_model sections of a run-report manifest:
     phase/tick timeline, per-stage F/B/W/idle attribution, predicted vs
@@ -300,6 +367,9 @@ def report_breakdown(manifest: dict) -> None:
                   f"{row.get('bubble_measured', 0.0):6.1%}")
     if isinstance(cm, dict):
         cost_model_breakdown(cm)
+    mem = manifest.get("memory")
+    if isinstance(mem, dict):
+        memory_breakdown(mem)
 
 
 def main():

@@ -10,7 +10,12 @@ the same (name, backend, schedule) group:
 - ``tokens_per_sec`` drops by more than ``--threshold`` (default 10%),
 - ``mfu`` drops by more than the threshold,
 - ``bubble`` (measured bubble fraction when the report has telemetry,
-  else the table-exact prediction) rises by more than the threshold.
+  else the table-exact prediction) rises by more than the threshold,
+- ``peak_temp_bytes`` (XLA's compiled scratch high-water mark from the
+  report's ``memory`` section) or ``peak_live_bytes`` (the sampled
+  ``memory_stats()`` watermark) grows by more than the threshold — the
+  HBM guard: a schedule or remat change that silently inflates memory
+  fails here before it OOMs a real chip.
 
 CPU-proxy runs (backend == "cpu") are always warn-only: a simulated-CPU
 host serializes every "parallel" tick, so its wall-clock jitters with
@@ -68,6 +73,8 @@ def extract_metrics(manifest) -> dict:
             "bubble": pred.get("bubble_table_exact"),
             "predicted_step_s": pred.get("step_s"),
             "measured_step_s": None,
+            "peak_temp_bytes": None,
+            "peak_live_bytes": None,
         }
     gauges = manifest.get("gauges") or {}
     cm = manifest.get("cost_model")
@@ -88,11 +95,15 @@ def extract_metrics(manifest) -> dict:
                   "bubble_measured_mean")
     if bubble is None:
         bubble = _get(cm, "predicted", "bubble_table_exact")
+    mem = manifest.get("memory")
+    peak_temp = _get(mem, "compiled", "temp_bytes")
+    peak_live = _get(mem, "live", "peak_bytes_in_use")
     return {
         "t": time.time(),
         "name": _get(manifest, "meta", "name") or "unknown",
         "backend": _get(manifest, "meta", "backend") or "unknown",
         "schedule": (_get(cm, "schedule")
+                     or _get(mem, "schedule")
                      or _get(manifest, "meta", "schedule", "name")
                      or "unknown"),
         "tokens_per_sec": tokens_per_sec,
@@ -100,6 +111,8 @@ def extract_metrics(manifest) -> dict:
         "bubble": bubble,
         "predicted_step_s": _get(cm, "predicted", "step_s"),
         "measured_step_s": _get(cm, "measured", "step_s"),
+        "peak_temp_bytes": peak_temp,
+        "peak_live_bytes": peak_live,
     }
 
 
@@ -134,7 +147,8 @@ def check(row, history, threshold, window) -> list:
         return []
     problems = []
     for key, direction in (("tokens_per_sec", "down"), ("mfu", "down"),
-                           ("bubble", "up")):
+                           ("bubble", "up"), ("peak_temp_bytes", "up"),
+                           ("peak_live_bytes", "up")):
         val = row.get(key)
         prior = [r[key] for r in group
                  if isinstance(r.get(key), (int, float))]
@@ -190,7 +204,8 @@ def main(argv=None) -> int:
                        else f"OK vs {n_prior} prior run(s)")
             print(f"regress: {label}: {verdict} "
                   f"(tokens/s={row['tokens_per_sec']}, mfu={row['mfu']}, "
-                  f"bubble={row['bubble']})")
+                  f"bubble={row['bubble']}, "
+                  f"temp_bytes={row['peak_temp_bytes']})")
         else:
             soft = args.warn_only or cpu_proxy
             tag = ("WARN (cpu proxy)" if cpu_proxy and not args.warn_only

@@ -106,6 +106,17 @@ def main() -> int:
         return 1
     report.attach_serving(serving_summary(static))
 
+    # memory observatory: analytic KV/params accounting + XLA's numbers
+    # for the already-compiled serving block (docs/observability.md)
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.memory_model import (
+        serving_memory_section)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        aot_memory_analysis)
+    report.attach_memory(serving_memory_section(
+        cfg, program,
+        compiled=aot_memory_analysis(program.step, *engine.weights,
+                                     program.init_state())))
+
     manifest = report.write()
     validate_report(manifest)  # write() validates too; belt and suspenders
     rows = manifest.get("serving", [])
@@ -113,9 +124,32 @@ def main() -> int:
         print("serve_smoke: serving section missing or empty",
               file=sys.stderr)
         return 1
+    if "memory" not in manifest or not manifest["memory"]["analytic"].get(
+            "kv_cache_bytes_per_device"):
+        print("serve_smoke: memory section missing or without KV bytes",
+              file=sys.stderr)
+        return 1
+
+    # per-request async spans (serve_admit -> serve_finish, with the
+    # on-device tick stamps in the args) on a "requests" Perfetto track
+    from distributed_training_with_pipeline_parallelism_tpu.utils.telemetry import (
+        write_perfetto_trace)
+    trace_path = write_perfetto_trace(
+        None, os.path.join(out_dir, "requests_trace.json"),
+        serving_events=report.events)
+    import json
+    with open(trace_path) as fh:
+        tr = json.load(fh)
+    n_b = sum(1 for e in tr["traceEvents"] if e.get("ph") == "b")
+    if n_b != len(requests):
+        print(f"serve_smoke: requests trace has {n_b} spans for "
+              f"{len(requests)} requests", file=sys.stderr)
+        return 1
+
     print(f"serve_smoke: OK — {len(requests)} requests bit-matched the "
           f"oracle; continuous {res.ticks} ticks vs static {static.ticks}; "
-          f"report at {os.path.join(out_dir, 'report.json')}")
+          f"report at {os.path.join(out_dir, 'report.json')}; request "
+          f"spans at {trace_path}")
     return 0
 
 

@@ -9,6 +9,9 @@ artifact): build a 4-stage 1F1B step on a simulated CPU mesh with a
 - a per-stage F/B/W/idle breakdown,
 - a ``cost_model`` section whose table-exact bubble prediction matches
   the static verifier's idle fraction *exactly* (same integer count),
+- a ``memory`` section whose analytic per-device activation/grad bytes
+  equal the verifier's slot live peaks times the slot slab bytes *to the
+  integer*, with XLA's AOT argument accounting reconciled on top,
 - a Perfetto ``trace.json`` that round-trips as valid Chrome-trace JSON,
 - a ``RunReport`` manifest that passes ``validate_report``.
 
@@ -127,6 +130,34 @@ def main() -> int:
               file=sys.stderr)
         return 1
 
+    # bytes-domain twin (docs/observability.md "Memory observatory"):
+    # analytic per-device HBM from the verifier's slot live peaks must
+    # equal live_peak x slot_bytes to the integer, and XLA's AOT
+    # argument accounting must reconcile with the analytic params+inputs
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.memory_model import (
+        memory_model_section)
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        aot_memory_analysis)
+    mem = memory_model_section(
+        cs, cfg, batch_size=int(tokens.shape[0]),
+        seq_length=int(tokens.shape[1]), table_report=table_report,
+        compiled=aot_memory_analysis(step, params, tokens, targets),
+        telemetry=tel)
+    report.attach_memory(mem)
+    slot_b = mem["analytic"]["act_slot_bytes"]
+    for pd in mem["analytic"]["per_device"]:
+        d = pd["device"]
+        if pd["act_bytes"] != table_report.act_live_peak[d] * slot_b \
+                or pd["grad_bytes"] != table_report.grad_live_peak[d] * slot_b:
+            print(f"telemetry_smoke: device {d} analytic bytes drifted from "
+                  f"live_peak x slot_bytes", file=sys.stderr)
+            return 1
+    rec = mem.get("reconciliation")
+    if rec is None or not rec["ok"]:
+        print(f"telemetry_smoke: compiled memory did not reconcile: {rec}",
+              file=sys.stderr)
+        return 1
+
     trace_path = write_perfetto_trace(tel, os.path.join(out_dir,
                                                         "trace.json"))
     import json
@@ -138,10 +169,15 @@ def main() -> int:
 
     manifest = report.write()
     validate_report(manifest)  # write() validates too; belt and suspenders
+    if "memory" not in manifest:
+        print("telemetry_smoke: manifest has no memory section",
+              file=sys.stderr)
+        return 1
     print(f"telemetry_smoke: OK — {len(phases)} phases over "
           f"{cs.table.shape[0]} ticks, bubble(table-exact)="
           f"{sec['predicted']['bubble_table_exact']:.4f}, "
           f"mfu={sec['measured']['mfu']:.2e}, "
+          f"mem rel err={rec['argument_rel_err']:.4f}, "
           f"{len(trace['traceEvents'])} trace events, report at "
           f"{os.path.join(out_dir, 'report.json')}")
     return 0

@@ -15,10 +15,12 @@ set -o pipefail
 cd "$(dirname "$0")/.."
 BUDGET="${1:-870}"
 # Static analysis first (own small budget, no jax execution): tick-table
-# hazard verifier over every registered schedule, repo lint, and the
-# jaxpr audit pinning traced step functions to the tables' predicted
-# collective counts. The JSON report lands in /tmp/check_report.json for
-# CI artifact upload (docs/static_analysis.md).
+# hazard verifier over every registered schedule, repo lint, the jaxpr
+# audit pinning traced step functions to the tables' predicted
+# collective counts, and the memory pricer pinning analytic HBM bytes
+# to the verifier's slot live peaks over the same grid. The JSON report
+# lands in /tmp/check_report.json for CI artifact upload
+# (docs/static_analysis.md).
 if ! timeout -k 10 300 \
     python scripts/check.py --all --json /tmp/check_report.json; then
   echo "CHECK=fail"
@@ -27,8 +29,10 @@ fi
 echo "CHECK=ok"
 # Telemetry liveness next (own small budget, not charged to the suite's):
 # one instrumented pipeline step must produce a validated run report —
-# the observability layer's equivalent of "does it import". The report
-# lands in /tmp/telemetry_smoke for CI artifact upload.
+# the observability layer's equivalent of "does it import" — including
+# a memory section whose analytic bytes match the verifier's slot
+# peaks to the integer and reconcile with XLA's AOT accounting. The
+# report lands in /tmp/telemetry_smoke for CI artifact upload.
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python scripts/telemetry_smoke.py /tmp/telemetry_smoke; then
   echo "TELEMETRY_SMOKE=fail"
@@ -68,7 +72,8 @@ fi
 echo "SEARCH_SMOKE=ok"
 # Serving liveness next (same discipline): a small continuous-batching
 # run must bit-match the single-device oracle and produce a validated
-# report with TTFT/TPOT rows. Lands in /tmp/serve_smoke for CI upload.
+# report with TTFT/TPOT rows, a KV-cache memory section, and a
+# per-request Perfetto trace. Lands in /tmp/serve_smoke for CI upload.
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python scripts/serve_smoke.py /tmp/serve_smoke; then
   echo "SERVE_SMOKE=fail"
