@@ -38,6 +38,19 @@ Three rules, each encoding a contract documented elsewhere in the repo
     artifact, which is what makes the static certification meaningful
     (docs/static_analysis.md "Schedule compiler").
 
+``dynamics-sync-read``
+    No host fetch (``jax.device_get``, ``jax.block_until_ready``, or a
+    ``float(...)`` coercion) of a training-dynamics statistic —
+    identifiers or dict keys like ``sq_mb``, ``grad_norm_per_stage``,
+    ``nonfinite_per_stage``, ``last_bad_stage``, ``dyn_latest`` —
+    outside the modules that own the log-sync boundary
+    (``utils/train.py``, ``utils/dynamics.py``) and the off-the-clock
+    sweep probe (``utils/sweep.py``). The dynamics contract
+    (docs/observability.md §7) is that per-stage stats live in
+    device-resident buffers and are read **only** when the loss is
+    synced anyway; a fetch anywhere else adds a device round-trip per
+    step and silently serializes the pipeline.
+
 The linter is stdlib-only (``ast``) — no jax import, safe for CI legs
 that run before any backend exists.
 """
@@ -248,6 +261,56 @@ def _lint_raw_tables(tree: ast.AST, path: str,
                         "compile_order or a certified artifact"))
 
 
+# dynamics-sync-read: modules that own the log-sync boundary (train's
+# fit loop, the dynamics host helpers) or read off the timed clock
+# (sweep's post-loop probe).
+_DYN_SYNC_ALLOWLIST = ("utils/train.py", "utils/dynamics.py",
+                       "utils/sweep.py")
+# identifiers / dict keys that name device-resident dynamics stats
+_DYN_STAT_NAMES = frozenset({
+    "sq_mb", "dyn_latest", "dyn_stats",
+    "grad_norm_per_stage", "grad_max_per_stage", "nonfinite_per_stage",
+    "grad_norm_per_layer", "param_rms_per_stage", "update_ratio_per_stage",
+    "last_bad_stage",
+})
+_SYNC_CALLS = frozenset({"jax.device_get", "jax.block_until_ready"})
+
+
+def _mentions_dyn_stat(node: ast.AST) -> Optional[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in _DYN_STAT_NAMES:
+            return sub.id
+        if isinstance(sub, ast.Attribute) and sub.attr in _DYN_STAT_NAMES:
+            return sub.attr
+        if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                and sub.value in _DYN_STAT_NAMES):
+            return sub.value
+    return None
+
+
+def _lint_dynamics_sync_reads(tree: ast.AST, path: str,
+                              findings: List[LintFinding]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted_name(node.func)
+        is_float = isinstance(node.func, ast.Name) and node.func.id == "float"
+        if dotted not in _SYNC_CALLS and not is_float:
+            continue
+        for arg in node.args:
+            stat = _mentions_dyn_stat(arg)
+            if stat is not None:
+                what = dotted if dotted in _SYNC_CALLS else "float"
+                findings.append(LintFinding(
+                    path, node.lineno, "dynamics-sync-read",
+                    f"{what}(...{stat}...): host fetch of a dynamics "
+                    f"statistic outside the log-sync boundary "
+                    f"(utils/train.py / utils/dynamics.py) — per-stage "
+                    f"stats stay device-resident and are read only when "
+                    f"the loss syncs (docs/observability.md §7)"))
+                break
+
+
 def lint_source(path: str, source: str,
                 package_relpath: Optional[str] = None) -> List[LintFinding]:
     """Lint one python source. ``package_relpath`` is the path relative to
@@ -269,6 +332,8 @@ def lint_source(path: str, source: str,
     rel_posix = rel.replace(os.sep, "/")
     if parts[0] != "analysis" and rel_posix not in _RAW_TABLE_ALLOWLIST:
         _lint_raw_tables(tree, path, findings)
+    if parts[0] != "analysis" and rel_posix not in _DYN_SYNC_ALLOWLIST:
+        _lint_dynamics_sync_reads(tree, path, findings)
     return findings
 
 

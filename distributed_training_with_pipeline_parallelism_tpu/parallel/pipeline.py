@@ -568,6 +568,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                           remat_backward=None,
                           unroll_ticks=None,
                           telemetry=None,
+                          dynamics=None,
                           ) -> Callable[[Pytree, jax.Array, jax.Array],
                                         Tuple[jax.Array, Pytree]]:
     """Build an (unjitted) ``(params, tokens, targets) -> (loss, grads)``
@@ -663,6 +664,22 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     ``"io_callback" not in str(jaxpr)``) and is bit-identical to an
     uninstrumented build.
 
+    ``dynamics`` (truthy, default None) additionally accumulates each
+    microbatch's squared gradient norm in an ``[M]`` f32 carry — the
+    backward/W units already materialize one gradient per (stage,
+    microbatch), and stages partition the (untied) parameters, so a
+    pipe-axis psum completes ``|g_m|^2`` with no extra backward work.
+    The step then returns ``(loss, grads, sq_mb)``; ``sq_mb[m]`` feeds
+    the gradient-noise-scale estimator (:mod:`..utils.dynamics`, data
+    replicas averaged — each holds a different microbatch sample).
+    Supported on dense untied-embedding pipe x data meshes with the tick
+    executor only (raises otherwise: the degenerate 1-stage fast path
+    and the phase-stored program never materialize per-microbatch
+    grads, and tied embeddings / tensor / seq / expert sharding break
+    the stages-partition-the-params norm decomposition). When falsy the
+    traced program is byte-identical to a build without the argument
+    (tests/test_dynamics.py pins the jaxpr).
+
     ``fsdp=True`` (pp x fsdp, ZeRO-3 within the pipeline): per-stage layer
     weights live sharded over the 'data' axis (per-leaf weight dim from
     :func:`_fsdp_shard_dims` — use :func:`fsdp_shard_params` to place
@@ -725,8 +742,38 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     # they occupy expert capacity, so load balance legitimately counts them)
     if moe is not None:
         _check_moe_mesh(cfg, moe, T, n_seq, n_ep)
+    dyn = bool(dynamics)
+    if dyn:
+        blockers = []
+        if moe is not None:
+            blockers.append("moe")
+        if fsdp:
+            blockers.append("fsdp")
+        if T > 1:
+            blockers.append("a 'model' mesh axis")
+        if n_seq > 1:
+            blockers.append("a 'seq' mesh axis")
+        if n_ep > 1:
+            blockers.append("an 'expert' mesh axis")
+        if cfg.tie_embeddings:
+            # the tied embedding takes grads from BOTH the first stage
+            # (wgrad through stage_embed) and the last (the head's vocab
+            # matmul), so per-stage squared norms no longer sum to
+            # |g_m|^2 — the decomposition the accumulator relies on
+            blockers.append("tie_embeddings")
+        if blockers:
+            raise ValueError(
+                "dynamics per-microbatch accumulation needs stages to "
+                "partition the parameters (dense untied pipe x data "
+                "mesh); unsupported here: " + ", ".join(blockers))
     if (D == 1 and n_data == 1 and T == 1 and n_seq == 1 and V == 1
             and moe is None and not use_dropout and not force_tick_executor):
+        if dyn:
+            raise ValueError(
+                "dynamics=True needs the tick executor's per-microbatch "
+                "gradients; the degenerate 1-stage fast path computes one "
+                "fused full-batch gradient — pass force_tick_executor="
+                "True with remat_backward=True")
         # Degenerate 1-stage pipeline == a plain full-batch train step: the
         # microbatch-accumulated, 1/M-scaled loss/grads equal the full-batch
         # mean exactly (asserted in tests/test_pipeline.py), so skip the tick
@@ -784,6 +831,12 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         use_phase = phase_ok
         use_stored = not phase_ok
     if use_phase:
+        if dyn:
+            raise ValueError(
+                "dynamics=True needs per-microbatch gradients; the "
+                "phase-stored program differentiates through its forward "
+                "tick scan and never materializes them — pass "
+                "remat_backward=True for the tick executor")
         fn = _make_phase_stored_grad_fn(cfg, mesh, sched, sp_attn_impl,
                                         tp_vocab_parallel)
         if telemetry is None:
@@ -1113,9 +1166,20 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     hop(bwd_send, fwd_perm, COL_STORE_B_POS_SLOT,
                         "pp/ring_bwd_rev"))
 
+        def _sq_tree(t):
+            """Sum of squared elements over a pytree, f32 (dynamics: one
+            unit's share of its microbatch's squared grad norm)."""
+            return sum((jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in jax.tree.leaves(t)), jnp.float32(0.0))
+
         def tick(carry, row_all, concrete=None, next_concrete=None):
-            (act_buf, grad_buf, res_bufs, recvs,
-             g_layers, g_embed, g_head, loss_acc) = carry
+            if dyn:
+                (act_buf, grad_buf, res_bufs, recvs,
+                 g_layers, g_embed, g_head, loss_acc, sq_mb) = carry
+            else:
+                (act_buf, grad_buf, res_bufs, recvs,
+                 g_layers, g_embed, g_head, loss_acc) = carry
+                sq_mb = None
             row = row_all[d]
 
             def ccol(col):
@@ -1238,7 +1302,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 wv, wm = row[COL_W_V], row[COL_W_M]
 
                 def wgrad_unit(operand):
-                    g_layers, g_embed, g_head = operand
+                    if dyn:
+                        g_layers, g_embed, g_head, sq_mb = operand
+                    else:
+                        g_layers, g_embed, g_head = operand
                     vv, mm = jnp.maximum(wv, 0), jnp.maximum(wm, 0)
                     last_stage = is_last_dev & (vv == last_chunk)
                     first_stage = is_first_dev & (vv == 0)
@@ -1261,6 +1328,21 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     g_head = jax.tree.map(jnp.add, g_head, gh)
                     # Embedding wgrad only on the first stage (its saved input
                     # IS the embed output, so gx is the embed cotangent).
+                    if dyn:
+                        # dynamics restructures the cond to return the
+                        # grad-or-zeros tree so its norm is observable;
+                        # the off path keeps the original trace untouched
+                        eg = jax.lax.cond(
+                            first_stage,
+                            lambda: jax.grad(lambda e: jnp.vdot(
+                                stage_embed(e, tokens_mb[mm],
+                                            mm).astype(jnp.float32),
+                                gx.astype(jnp.float32)))(embed),
+                            lambda: jax.tree.map(jnp.zeros_like, embed))
+                        g_embed = jax.tree.map(jnp.add, g_embed, eg)
+                        sq_mb = sq_mb.at[mm].add(
+                            _sq_tree(gp) + _sq_tree(gh) + _sq_tree(eg))
+                        return (g_layers, g_embed, g_head, sq_mb)
                     g_embed = jax.lax.cond(
                         first_stage,
                         lambda: jax.tree.map(
@@ -1272,14 +1354,20 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     return (g_layers, g_embed, g_head)
 
                 with jax.named_scope("pp/wgrad"):
-                    (g_layers, g_embed, g_head) = run_unit(
-                        wm >= 0, wgrad_unit, lambda op: op,
-                        (g_layers, g_embed, g_head),
+                    w_op = (g_layers, g_embed, g_head) + (
+                        (sq_mb,) if dyn else ())
+                    w_out = run_unit(
+                        wm >= 0, wgrad_unit, lambda operand: operand, w_op,
                         know=_concrete_know(ccol(COL_W_M)))
+                    if dyn:
+                        g_layers, g_embed, g_head, sq_mb = w_out
+                    else:
+                        g_layers, g_embed, g_head = w_out
 
                 return (act_buf, grad_buf, res_bufs,
                         transfers(fwd_send, bwd_send, next_concrete),
-                        g_layers, g_embed, g_head, loss_acc), None
+                        g_layers, g_embed, g_head, loss_acc) + (
+                            (sq_mb,) if dyn else ()), None
 
             def bwd_unit_stored(operand):
                 """Stored-activation backward: head+CE grads from live
@@ -1287,7 +1375,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 replaying the banked vjp residuals (x-independent leaves
                 re-derived live — the dummy-x forward chain is dead code
                 XLA eliminates). No stage forward is recomputed."""
-                g_layers, g_embed, g_head, loss_acc = operand
+                if dyn:
+                    g_layers, g_embed, g_head, loss_acc, sq_mb = operand
+                else:
+                    g_layers, g_embed, g_head, loss_acc = operand
                 vv, mm = jnp.maximum(bv, 0), jnp.maximum(bm, 0)
                 last_stage = is_last_dev & (vv == last_chunk)
                 first_stage = is_first_dev & (vv == 0)
@@ -1340,6 +1431,19 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
                                         g_layers, gp)
                 g_head = jax.tree.map(jnp.add, g_head, gh)
+                if dyn:
+                    eg = jax.lax.cond(
+                        first_stage,
+                        lambda: jax.grad(lambda e: jnp.vdot(
+                            stage_embed(e, tokens_mb[mm],
+                                        mm).astype(jnp.float32),
+                            gx.astype(jnp.float32)))(embed),
+                        lambda: jax.tree.map(jnp.zeros_like, embed))
+                    g_embed = jax.tree.map(jnp.add, g_embed, eg)
+                    sq_mb = sq_mb.at[mm].add(
+                        _sq_tree(gp) + _sq_tree(gh) + _sq_tree(eg))
+                    loss_acc = loss_acc + ce
+                    return (g_layers, g_embed, g_head, loss_acc, sq_mb), gx
                 g_embed = jax.lax.cond(
                     first_stage,
                     lambda: jax.tree.map(
@@ -1353,7 +1457,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 return (g_layers, g_embed, g_head, loss_acc), gx
 
             def bwd_unit_remat(operand):
-                g_layers, g_embed, g_head, loss_acc = operand
+                if dyn:
+                    g_layers, g_embed, g_head, loss_acc, sq_mb = operand
+                else:
+                    g_layers, g_embed, g_head, loss_acc = operand
                 vv, mm = jnp.maximum(bv, 0), jnp.maximum(bm, 0)
                 last_stage = is_last_dev & (vv == last_chunk)
                 first_stage = is_first_dev & (vv == 0)
@@ -1374,6 +1481,19 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 g_layers = jax.tree.map(lambda a, g: a.at[vv].add(g),
                                         g_layers, gp)
                 g_head = jax.tree.map(jnp.add, g_head, gh)
+                if dyn:
+                    eg = jax.lax.cond(
+                        first_stage,
+                        lambda: jax.grad(lambda e: jnp.vdot(
+                            stage_embed(e, tokens_mb[mm],
+                                        mm).astype(jnp.float32),
+                            gx.astype(jnp.float32)))(embed),
+                        lambda: jax.tree.map(jnp.zeros_like, embed))
+                    g_embed = jax.tree.map(jnp.add, g_embed, eg)
+                    sq_mb = sq_mb.at[mm].add(
+                        _sq_tree(gp) + _sq_tree(gh) + _sq_tree(eg))
+                    loss_acc = loss_acc + report
+                    return (g_layers, g_embed, g_head, loss_acc, sq_mb), gx
                 g_embed = jax.lax.cond(
                     first_stage,
                     lambda: jax.tree.map(
@@ -1389,11 +1509,17 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 return operand, jnp.zeros(mb_shape, dtype)
 
             with jax.named_scope("pp/bwd"):
-                (g_layers, g_embed, g_head, loss_acc), bwd_send = run_unit(
+                b_op = (g_layers, g_embed, g_head, loss_acc) + (
+                    (sq_mb,) if dyn else ())
+                b_out, bwd_send = run_unit(
                     bm >= 0,
                     bwd_unit_stored if use_stored else bwd_unit_remat,
-                    bwd_noop, (g_layers, g_embed, g_head, loss_acc),
+                    bwd_noop, b_op,
                     know=_concrete_know(ccol(COL_BWD_M)))
+                if dyn:
+                    g_layers, g_embed, g_head, loss_acc, sq_mb = b_out
+                else:
+                    g_layers, g_embed, g_head, loss_acc = b_out
             if reverse_routes:
                 grad_buf = store(grad_buf, bwd_send, COL_BWD_LOCAL_SLOT)
 
@@ -1401,7 +1527,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             # vshape placements add the two reverse channels
             return (act_buf, grad_buf, res_bufs,
                     transfers(fwd_send, bwd_send, next_concrete),
-                    g_layers, g_embed, g_head, loss_acc), None
+                    g_layers, g_embed, g_head, loss_acc) + (
+                        (sq_mb,) if dyn else ()), None
 
         n_chan = 4 if reverse_routes else 2
         carry0 = (
@@ -1414,7 +1541,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             jax.tree.map(jnp.zeros_like, embed),
             jax.tree.map(jnp.zeros_like, head),
             jnp.zeros((), jnp.float32),
-        )
+        ) + ((jnp.zeros((M,), jnp.float32),) if dyn else ())
         if unroll_ticks == "phases":
             # phase-compressed: one specialized scan body per unique row
             # pattern, each phase driven as a lax.scan over its real rows
@@ -1446,7 +1573,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             carry, _ = jax.lax.scan(tick, carry0, table)
             if telemetry is not None:
                 telemetry.emit(_tm.STEP_END, 0, _tm.probe_of(carry))
-        (_, _, _, _, g_layers, g_embed, g_head, loss_acc) = carry
+        if dyn:
+            (_, _, _, _, g_layers, g_embed, g_head, loss_acc,
+             sq_mb) = carry
+        else:
+            (_, _, _, _, g_layers, g_embed, g_head, loss_acc) = carry
 
         # Reductions: loss lives on the last stage only; embed/head grads on
         # one device each — psum replicates them across 'pipe'. Scale by 1/M
@@ -1501,6 +1632,15 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             g_layers = jax.tree_util.tree_map_with_path(ep_reduce, g_layers)
             g_embed, g_head = jax.tree.map(
                 lambda x: jax.lax.psum(x, EXPERT_AXIS), (g_embed, g_head))
+        if dyn:
+            # stages partition the (untied) params, so the pipe psum
+            # completes each microbatch's |g_m|^2; data replicas hold
+            # DIFFERENT microbatches — average their norms (each is one
+            # sample of E|g_small|^2, the GNS small-batch moment)
+            sq_mb = jax.lax.psum(sq_mb, PIPE_AXIS)
+            if n_data > 1:
+                sq_mb = jax.lax.psum(sq_mb * (1.0 / n_data), DATA_AXIS)
+            return loss, g_layers, g_embed, g_head, sq_mb
         return loss, g_layers, g_embed, g_head
 
     if moe is not None:
@@ -1541,15 +1681,19 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     sharded = _shard_map(
         spmd_fn, mesh,
         in_specs=in_specs,
-        out_specs=(P(), layer_spec, P(), head_spec),
+        out_specs=(P(), layer_spec, P(), head_spec) + (
+            (P(),) if dyn else ()),
     )
 
-    def unpack(loss, g_layers, g_embed, g_head):
-        return loss, {
+    def unpack(loss, g_layers, g_embed, g_head, *extras):
+        grads = {
             "embed": g_embed,
             "layers": unstack_stage_layers(g_layers, placement),
             "head": g_head,
         }
+        if dyn:
+            return loss, grads, extras[0]
+        return loss, grads
 
     if use_dropout:
         # Train-mode step: the caller supplies a per-step PRNG key; passing
@@ -1578,6 +1722,7 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                        remat_backward=None,
                        unroll_ticks=None,
                        telemetry=None,
+                       dynamics=None,
                        ) -> Callable[[Pytree, jax.Array, jax.Array],
                                      Tuple[jax.Array, Pytree]]:
     """Jitted ``(params, tokens, targets) -> (loss, grads)`` pipeline step.
@@ -1612,12 +1757,17 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     ``telemetry`` (opt-in ``utils.telemetry.PipelineTelemetry``) records a
     measured tick/phase timeline; None (default) compiles zero
     instrumentation (see :func:`make_pipeline_grad_fn`).
+
+    ``dynamics`` (truthy) returns ``(loss, grads, sq_mb)`` instead — the
+    per-microbatch squared grad norms feeding the gradient-noise-scale
+    estimator (see :func:`make_pipeline_grad_fn`; falsy compiles a
+    byte-identical program without the accumulator).
     """
     return jax.jit(make_pipeline_grad_fn(
         cfg, mesh, sched, force_tick_executor=force_tick_executor, moe=moe,
         sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel,
         fsdp=fsdp, remat_backward=remat_backward, unroll_ticks=unroll_ticks,
-        telemetry=telemetry))
+        telemetry=telemetry, dynamics=dynamics))
 
 
 def aot_memory_analysis(step, *args) -> Dict[str, Any]:

@@ -115,6 +115,12 @@ class FaultPlan:
       these global step indices. Baked into the traced train step as a
       step-index compare; requires an :class:`AnomalyGuard` (otherwise
       the poisoned update would corrupt the params forever).
+    - ``nan_grad_stage``: narrow ``nan_grad_steps`` to a single pipeline
+      stage — only that stage's layer-gradient rows are poisoned and
+      the loss stays finite, so ONLY the guard's per-stage non-finite
+      reduction can catch it; the skip verdict's ``last_bad_stage``
+      must name this stage (the attribution contract
+      ``scripts/resilience_smoke.py`` asserts).
     - ``data_fail_step``: the wrapped data iterator raises
       :class:`InjectedDataFault` instead of yielding this batch index
       (counted over the iterator's lifetime, resume drain included).
@@ -132,6 +138,7 @@ class FaultPlan:
       straggling request injected deterministically.
     """
     nan_grad_steps: Tuple[int, ...] = ()
+    nan_grad_stage: Optional[int] = None
     data_fail_step: Optional[int] = None
     kill_in_save_step: Optional[int] = None
     preempt_at_step: Optional[int] = None
@@ -418,14 +425,17 @@ class AnomalyGuard:
 
 def init_guard_state(start_step: int = 0) -> Dict[str, Any]:
     """Device-resident guard counters threaded through the guarded train
-    step: current global step, consecutive / total anomaly counts, and
-    the last anomalous step (-1 = none)."""
+    step: current global step, consecutive / total anomaly counts, the
+    last anomalous step (-1 = none), and the last anomaly's attribution
+    ``last_bad_stage`` (first pipeline stage with non-finite grads;
+    -2 = only the loss was non-finite; -1 = no anomaly yet)."""
     import jax.numpy as jnp
     i32 = jnp.int32
     return {"step": jnp.asarray(start_step, i32),
             "consec": jnp.zeros((), i32),
             "total": jnp.zeros((), i32),
-            "last_anomaly_step": jnp.asarray(-1, i32)}
+            "last_anomaly_step": jnp.asarray(-1, i32),
+            "last_bad_stage": jnp.asarray(-1, i32)}
 
 
 # ---------------------------------------------------------------------------

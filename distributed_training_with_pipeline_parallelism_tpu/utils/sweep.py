@@ -44,7 +44,8 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                        unroll_ticks=None,
                        report_dir: Optional[str] = None,
                        schedule_artifact: Optional[str] = None,
-                       oom_preflight: bool = True
+                       oom_preflight: bool = True,
+                       dynamics: bool = False
                        ) -> Dict[str, float]:
     """Run one pipeline experiment; returns the reference's metrics dict plus
     bubble analytics, or ``{"error": ...}`` on failure.
@@ -80,7 +81,14 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
     against the detected chip's HBM capacity BEFORE compiling anything;
     a predicted overflow returns a ``skip_reason="predicted_oom"`` row
     (with the predicted bytes) instead of crashing mid-sweep. Pass
-    ``False`` to force the compile anyway."""
+    ``False`` to force the compile anyway.
+
+    ``dynamics``: also run one dynamics-instrumented gradient pass after
+    the timed loop (off the clock — the timed throughput columns are
+    unaffected) and fill the ``grad_norm_final`` / ``gns`` /
+    ``n_skipped_attributed`` model-health columns
+    (docs/observability.md §7). Off by default; the columns are present
+    either way (None when off) so DataFrames concatenate cleanly."""
     import jax
 
     from ..models.transformer import transformer_init
@@ -196,6 +204,30 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                 else ("unrolled" if cs.table.shape[0] <= 64 else "phases")),
             "host_serialized": jax.devices()[0].platform == "cpu",
         })
+        # model-health columns: present on every row (None when dynamics
+        # is off) so sweeps with and without them concatenate cleanly
+        dyn_cols: Dict[str, object] = {"grad_norm_final": None, "gns": None,
+                                       "n_skipped_attributed": None}
+        if dynamics:
+            from ..parallel.pipeline import make_pipeline_grad_fn
+            from .dynamics import GNSEstimator, stage_stats
+            # one instrumented pass off the clock; the tick executor with
+            # remat is the configuration the GNS accumulator supports
+            dyn_grad = make_pipeline_grad_fn(
+                cfg, mesh, sched, remat_backward=True, unroll_ticks=True,
+                dynamics=True)
+            _, grads_d, sq_mb = dyn_grad(params, tokens, targets)
+            st = stage_stats(cfg.n_layers, num_devices * n_virtual, grads_d)
+            dyn_cols["grad_norm_final"] = float(st["grad_norm"])
+            dyn_cols["n_skipped_attributed"] = 0  # no guard in a sweep row
+            if n_microbatches > 1:
+                est = GNSEstimator(
+                    batch_small=batch_size * seq_length / n_microbatches,
+                    batch_big=batch_size * seq_length)
+                est.update(float(sq_mb.mean()),
+                           float(st["grad_norm"]) ** 2)
+                dyn_cols["gns"] = est.value()
+        metrics.update(dyn_cols)
         if artifact_info is not None:
             metrics["schedule_artifact_digest"] = \
                 artifact_info["table_digest"]
@@ -215,6 +247,12 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
                 remat_backward=remat_backward,
                 compiled=aot_memory_analysis(step, params, tokens, targets))
             report.attach_memory(mem_section)
+            if dynamics and dyn_cols["grad_norm_final"] is not None:
+                from .dynamics import dynamics_section
+                report.attach_dynamics(dynamics_section(
+                    num_devices * n_virtual, last_stats=st,
+                    gns=dyn_cols["gns"],
+                    gns_updates=0 if dyn_cols["gns"] is None else 1))
             manifest = report.manifest()
             validate_report(manifest)
             os.makedirs(report_dir, exist_ok=True)
@@ -267,6 +305,12 @@ def run_all_experiments(layers: Sequence[int] = (4, 8, 12),
         if verbose:
             print(f"    throughput: {result['throughput']:.2f} tokens/sec",
                   flush=True)
+            if result.get("grad_norm_final") is not None:
+                gns = result.get("gns")
+                print(f"    dynamics: grad_norm "
+                      f"{result['grad_norm_final']:.4f}, gns "
+                      + (f"{gns:.1f}" if gns is not None else "n/a"),
+                      flush=True)
         rows.append({
             "n_layers": L, "n_heads": H, "num_processes": D, "schedule": s,
             **result,
@@ -294,6 +338,35 @@ def compute_speedup_and_efficiency(df: pd.DataFrame) -> pd.DataFrame:
                 "schedule": schedule, "speedup": speedup,
                 "efficiency": speedup / D * 100.0,
             })
+    return pd.DataFrame(rows)
+
+
+def summarize_dynamics(df: pd.DataFrame) -> pd.DataFrame:
+    """Per-schedule model-health summary over a ``dynamics=True`` sweep:
+    median ``grad_norm_final`` / ``gns`` and total attributed skips.
+    Rows the dynamics pass did not run for (column absent or None) are
+    excluded; an all-None sweep summarizes to an empty frame."""
+    empty = pd.DataFrame(
+        columns=["schedule", "n", "grad_norm_final_median",
+                 "gns_median", "n_skipped_attributed"])
+    if "grad_norm_final" not in df.columns:
+        return empty
+    d = df[df["grad_norm_final"].notna()]
+    if d.empty:  # all-None: same schema as the column-absent case
+        return empty
+    rows = []
+    for schedule, g in d.groupby("schedule"):
+        gns = g["gns"].dropna() if "gns" in g.columns else []
+        skipped = (g["n_skipped_attributed"].dropna().sum()
+                   if "n_skipped_attributed" in g.columns else 0)
+        rows.append({
+            "schedule": schedule,
+            "n": len(g),
+            "grad_norm_final_median": float(g["grad_norm_final"].median()),
+            "gns_median": (float(pd.Series(gns).median())
+                           if len(gns) else None),
+            "n_skipped_attributed": int(skipped),
+        })
     return pd.DataFrame(rows)
 
 

@@ -445,7 +445,8 @@ def critical_path(telemetry: PipelineTelemetry) -> Dict[str, Any]:
 
 
 def perfetto_trace(telemetry: PipelineTelemetry,
-                   serving_events: Optional[List[Dict[str, Any]]] = None
+                   serving_events: Optional[List[Dict[str, Any]]] = None,
+                   dynamics_events: Optional[List[Dict[str, Any]]] = None
                    ) -> Dict[str, Any]:
     """The measured timeline as a Chrome-trace/Perfetto JSON object.
 
@@ -460,9 +461,11 @@ def perfetto_trace(telemetry: PipelineTelemetry,
     boundaries, drawn right next to the F/B/W slices. ``serving_events``:
     RunReport event rows — ``serve_admit``/``serve_finish`` pairs become
     async request slices on a separate "requests" process
-    (:func:`perfetto_request_events`). Timestamps are microseconds from
-    the first stamp, sorted ascending; load the written file in
-    ui.perfetto.dev or chrome://tracing."""
+    (:func:`perfetto_request_events`). ``dynamics_events``: RunReport
+    ``dynamics`` event rows — per-stage grad-norm counter tracks on a
+    "training dynamics" process (:func:`perfetto_dynamics_events`).
+    Timestamps are microseconds from the first stamp, sorted ascending;
+    load the written file in ui.perfetto.dev or chrome://tracing."""
     from ..parallel.schedules import (COL_BWD_M, COL_BWD_V, COL_FWD_M,
                                       COL_FWD_V, COL_W_M, COL_W_V)
     if telemetry.table is None:
@@ -531,6 +534,11 @@ def perfetto_trace(telemetry: PipelineTelemetry,
                          "peak_bytes_in_use": s["peak_bytes_in_use"]}})
     if serving_events:
         events.extend(perfetto_request_events(serving_events))
+    n_dyn = 0
+    if dynamics_events:
+        dyn_rows = perfetto_dynamics_events(dynamics_events)
+        n_dyn = sum(1 for e in dyn_rows if e["ph"] == "C")
+        events.extend(dyn_rows)
     # sorted ts is part of the format contract (and what the schema test
     # pins); metadata first among equals so track names land before slices
     events.sort(key=lambda e: (e["ts"], 0 if e["ph"] == "M" else 1))
@@ -539,7 +547,8 @@ def perfetto_trace(telemetry: PipelineTelemetry,
         "displayTimeUnit": "ms",
         "otherData": {"executor": telemetry.executor, "n_devices": D,
                       "n_ticks": T, "n_flows": flow_id,
-                      "n_memory_counters": n_counters},
+                      "n_memory_counters": n_counters,
+                      "n_dynamics_counters": n_dyn},
     }
 
 
@@ -594,20 +603,66 @@ def perfetto_request_events(serving_events: List[Dict[str, Any]],
     return out
 
 
+def perfetto_dynamics_events(dynamics_events: List[Dict[str, Any]],
+                             pid: int = 2) -> List[Dict[str, Any]]:
+    """Per-stage grad-norm counter tracks from RunReport ``dynamics``
+    event rows (the rows ``fit`` streams at every log sync), one ``"C"``
+    counter per (log point, stage) plus global grad-norm and GNS tracks
+    — the model-health twin of the HBM sawtooth. The rows carry the
+    event stream's wall clock (a different clock than the executor
+    stamps), so they land on their own "training dynamics" process,
+    normalized to the first dynamics row; within the process, step
+    ordering is exact."""
+    rows = [r for r in (dynamics_events or [])
+            if r.get("kind") == "dynamics" and "t" in r]
+    if not rows:
+        return []
+    us = 1e6
+    origin = min(r["t"] for r in rows)
+    out: List[Dict[str, Any]] = [{
+        "ph": "M", "name": "process_name", "pid": pid, "tid": 0, "ts": 0.0,
+        "args": {"name": "training dynamics"}}]
+
+    def finite(x):
+        return isinstance(x, (int, float)) and np.isfinite(x)
+
+    for r in sorted(rows, key=lambda r: r["t"]):
+        ts = (r["t"] - origin) * us
+        if finite(r.get("grad_norm")):
+            out.append({"ph": "C", "name": "grad_norm", "cat": "dynamics",
+                        "pid": pid, "tid": 0, "ts": ts,
+                        "args": {"grad_norm": float(r["grad_norm"])}})
+        if finite(r.get("gns")):
+            out.append({"ph": "C", "name": "gns", "cat": "dynamics",
+                        "pid": pid, "tid": 0, "ts": ts,
+                        "args": {"gns": float(r["gns"])}})
+        for s, v in enumerate(r.get("grad_norm_per_stage") or []):
+            if finite(v):
+                out.append({
+                    "ph": "C", "name": f"grad_norm stage {s}",
+                    "cat": "dynamics", "pid": pid, "tid": 0, "ts": ts,
+                    "args": {"grad_norm": float(v)}})
+    return out
+
+
 def write_perfetto_trace(telemetry: Optional[PipelineTelemetry], path: str,
-                         serving_events: Optional[List[Dict[str, Any]]] = None
+                         serving_events: Optional[List[Dict[str, Any]]] = None,
+                         dynamics_events: Optional[List[Dict[str, Any]]] = None
                          ) -> str:
     """Serialize :func:`perfetto_trace` to ``path``; returns the path.
     With ``telemetry=None`` (a serving-only run has no pipeline
-    telemetry) the trace holds just the requests track."""
+    telemetry) the trace holds just the requests/dynamics tracks."""
     if telemetry is None:
+        rows = perfetto_request_events(serving_events or [])
+        rows.extend(perfetto_dynamics_events(dynamics_events or []))
         trace: Dict[str, Any] = {
-            "traceEvents": perfetto_request_events(serving_events or []),
+            "traceEvents": rows,
             "displayTimeUnit": "ms",
             "otherData": {"executor": "serving"},
         }
     else:
-        trace = perfetto_trace(telemetry, serving_events=serving_events)
+        trace = perfetto_trace(telemetry, serving_events=serving_events,
+                               dynamics_events=dynamics_events)
     with open(path, "w") as fh:
         json.dump(trace, fh)
     return path
@@ -702,6 +757,7 @@ class RunReport:
         self.static_analysis: Optional[Dict[str, Any]] = None
         self.cost_model: Optional[Dict[str, Any]] = None
         self.memory: Optional[Dict[str, Any]] = None
+        self.dynamics: Optional[Dict[str, Any]] = None
         self.out_dir = out_dir
         self._events_fh = None
         # the event stream is written from the training loop AND from
@@ -780,6 +836,14 @@ class RunReport:
         block — the record ``scripts/regress.py`` reads."""
         self.cost_model = dict(section)
 
+    def attach_dynamics(self, section: Dict[str, Any]) -> None:
+        """Embed the training-dynamics summary
+        (:func:`utils.dynamics.dynamics_section`: final grad norm,
+        gradient-noise scale, per-stage stat rows, attributed-skip count
+        and the run's forensic bundles) as the manifest's ``dynamics``
+        block — the model-health record ``scripts/regress.py`` tracks."""
+        self.dynamics = dict(section)
+
     def attach_memory(self, section: Dict[str, Any]) -> None:
         """Embed the HBM accounting
         (:func:`analysis.memory_model.memory_model_section` /
@@ -817,6 +881,8 @@ class RunReport:
             out["cost_model"] = _jsonable(self.cost_model)
         if self.memory is not None:
             out["memory"] = _jsonable(self.memory)
+        if self.dynamics is not None:
+            out["dynamics"] = _jsonable(self.dynamics)
         return out
 
     def write(self, path: Optional[str] = None) -> Dict[str, Any]:
@@ -1035,3 +1101,38 @@ def validate_report(manifest: Dict[str, Any]) -> None:
                 fail("memory.live needs a bool 'available'")
             if not isinstance(live.get("per_device"), list):
                 fail("memory.live.per_device must be a list")
+    dyn = manifest.get("dynamics")
+    if dyn is not None:
+        if not isinstance(dyn, dict):
+            fail("dynamics must be a dict")
+        if not isinstance(dyn.get("n_stages"), int):
+            fail("dynamics.n_stages must be an int")
+        for key in ("gns_updates", "n_skipped_attributed"):
+            if not isinstance(dyn.get(key), int):
+                fail(f"dynamics.{key} must be an int")
+        # grad_norm_final / gns may be None (no log sync ran / estimator
+        # unarmed) or a number; a poisoned final step serializes as the
+        # string repr ("nan") — still a valid record of what happened
+        for key in ("grad_norm_final", "gns"):
+            if key in dyn and not isinstance(
+                    dyn[key], (int, float, str, type(None))):
+                fail(f"dynamics.{key} must be a number, string or null")
+        rows = dyn.get("per_stage")
+        if not isinstance(rows, list):
+            fail("dynamics.per_stage must be a list")
+        for row in rows:
+            if not isinstance(row, dict) or not isinstance(
+                    row.get("stage"), int):
+                fail("dynamics.per_stage rows need an int 'stage'")
+            if not isinstance(row.get("nonfinite"), int):
+                fail("dynamics.per_stage rows need an int 'nonfinite'")
+            for key in ("grad_norm", "grad_max", "param_rms",
+                        "update_ratio"):
+                if key in row and not isinstance(
+                        row[key], (int, float, str)):
+                    fail(f"dynamics.per_stage.{key} must be a number "
+                         "(or a non-finite repr string)")
+        bundles = dyn.get("forensic_bundles")
+        if not isinstance(bundles, list) or not all(
+                isinstance(b, str) for b in bundles):
+            fail("dynamics.forensic_bundles must be a list of filenames")

@@ -24,9 +24,11 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh
 
+from ..parallel.mesh import PIPE_AXIS
 from ..parallel.pipeline import make_pipeline_grad_fn
 from .checkpoint import restore_checkpoint, save_checkpoint
 from .config import ModelConfig, ScheduleConfig
+from .dynamics import as_dynamics_config, nonfinite_per_stage, stage_stats
 
 Pytree = Any
 
@@ -37,7 +39,7 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     tp_vocab_parallel: bool = False,
                     fsdp: bool = False, remat_backward=None,
                     unroll_ticks=None, telemetry=None,
-                    guard=None, fault_plan=None,
+                    guard=None, fault_plan=None, dynamics=None,
                     ) -> Callable[[Pytree, Any, jax.Array, jax.Array],
                                   Tuple[Pytree, Any, jax.Array]]:
     """Jitted ``(params, opt_state, tokens, targets) ->
@@ -62,31 +64,86 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     ``guard`` (a ``utils.resilience.AnomalyGuard``) switches to the
     *guarded* step: ``(params, opt_state, tokens, targets[, rng],
     guard_state) -> (params, opt_state, loss, guard_state)``. Inside the
-    same XLA program it checks loss and global grad norm for finiteness
-    and, on failure, SELECTS the incoming params/opt_state (the
-    anomalous step is skipped, the optimizer clock does not advance) and
-    bumps device-resident anomaly counters (``resilience.
-    init_guard_state``). Everything stays on device — the counters ride
-    the loss fetch at the caller's existing sync points, so the happy
-    path costs zero extra host syncs. ``fault_plan.nan_grad_steps``
-    (requires ``guard``) poisons the gradients at those global step
-    indices with NaN, baked into the traced program as a step-index
-    compare — the deterministic blowup the guard tests recover from."""
+    same XLA program it checks the loss and a PER-STAGE non-finite
+    reduction over the gradients (stages partition the layer stack, so
+    the poisoned stage is identified without a host round-trip) and, on
+    failure, SELECTS the incoming params/opt_state (the anomalous step
+    is skipped, the optimizer clock does not advance) and bumps
+    device-resident anomaly counters (``resilience.init_guard_state``)
+    including ``last_bad_stage`` — the first non-finite stage index, -2
+    when only the loss was non-finite, -1 when no anomaly has fired.
+    Everything stays on device — the counters ride the loss fetch at
+    the caller's existing sync points, so the happy path costs zero
+    extra host syncs. ``fault_plan.nan_grad_steps`` (requires
+    ``guard``) poisons the gradients at those global step indices with
+    NaN, baked into the traced program as a step-index compare — the
+    deterministic blowup the guard tests recover from; with
+    ``fault_plan.nan_grad_stage`` set, only that stage's layer-grad
+    rows are poisoned (the loss stays finite), exercising the per-stage
+    attribution path specifically.
+
+    ``dynamics`` (True or a ``utils.dynamics.DynamicsConfig``) appends a
+    device-resident stat dict to the step's outputs — per-stage/
+    per-layer grad norms, param RMS, update ratios, non-finite counts
+    (:func:`utils.dynamics.stage_stats`) plus, when the pipeline
+    supports it (``DynamicsConfig.gns``), the per-microbatch squared
+    grad norms feeding the gradient-noise-scale estimator. Like the
+    guard counters the dict is read only at the caller's log syncs;
+    with ``dynamics`` falsy the traced program is byte-identical to a
+    build without the argument."""
+    dcfg = as_dynamics_config(dynamics)
+    want_gns = dcfg is not None and dcfg.gns
     grad_fn = make_pipeline_grad_fn(cfg, mesh, sched, moe=moe,
                                     sp_attn_impl=sp_attn_impl,
                                     tp_vocab_parallel=tp_vocab_parallel,
                                     fsdp=fsdp, remat_backward=remat_backward,
                                     unroll_ticks=unroll_ticks,
-                                    telemetry=telemetry)
+                                    telemetry=telemetry,
+                                    dynamics=want_gns)
+    n_stages = mesh.shape[PIPE_AXIS] * sched.n_virtual
     nan_steps = tuple(getattr(fault_plan, "nan_grad_steps", ()) or ())
+    nan_stage = getattr(fault_plan, "nan_grad_stage", None)
     if nan_steps and guard is None:
         raise ValueError(
             "fault_plan.nan_grad_steps requires an AnomalyGuard — injected "
             "NaN grads without the guard would corrupt the params forever")
+    if nan_stage is not None and not 0 <= nan_stage < n_stages:
+        raise ValueError(f"fault_plan.nan_grad_stage={nan_stage} out of "
+                         f"range for {n_stages} stages")
+
+    def run_grads(params, tokens, targets, rng):
+        """(loss, grads, sq_mb|None) — arity bridge over the dynamics
+        pipeline variant."""
+        args = (params, tokens, targets) + (() if rng is None else (rng,))
+        if want_gns:
+            return grad_fn(*args)
+        loss, grads = grad_fn(*args)
+        return loss, grads, None
+
+    def dyn_stats(grads, params, updates, sq_mb):
+        stats = stage_stats(cfg.n_layers, n_stages, grads, params=params,
+                            updates=updates)
+        if sq_mb is not None:
+            stats["sq_mb"] = sq_mb
+        return stats
 
     if guard is None:
         if cfg.dropout > 0.0:
             # train-mode dropout: the step takes a per-step PRNG key
+            if dcfg is not None:
+                @jax.jit
+                def train_step_dropout_dyn(params, opt_state, tokens,
+                                           targets, rng):
+                    loss, grads, sq_mb = run_grads(params, tokens, targets,
+                                                   rng)
+                    updates, opt_state = optimizer.update(grads, opt_state,
+                                                          params)
+                    dyn = dyn_stats(grads, params, updates, sq_mb)
+                    params = optax.apply_updates(params, updates)
+                    return params, opt_state, loss, dyn
+
+                return train_step_dropout_dyn
+
             @jax.jit
             def train_step_dropout(params, opt_state, tokens, targets, rng):
                 loss, grads = grad_fn(params, tokens, targets, rng)
@@ -96,6 +153,18 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 return params, opt_state, loss
 
             return train_step_dropout
+
+        if dcfg is not None:
+            @jax.jit
+            def train_step_dyn(params, opt_state, tokens, targets):
+                loss, grads, sq_mb = run_grads(params, tokens, targets, None)
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                dyn = dyn_stats(grads, params, updates, sq_mb)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, loss, dyn
+
+            return train_step_dyn
 
         @jax.jit
         def train_step(params, opt_state, tokens, targets):
@@ -107,23 +176,55 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
         return train_step
 
     def guarded(params, opt_state, tokens, targets, guard_state, rng=None):
-        if rng is None:
-            loss, grads = grad_fn(params, tokens, targets)
-        else:
-            loss, grads = grad_fn(params, tokens, targets, rng)
+        loss, grads, sq_mb = run_grads(params, tokens, targets, rng)
         step = guard_state["step"]
         if nan_steps:
             bad = functools.reduce(
                 jnp.logical_or, [step == k for k in nan_steps])
-            poison = jnp.where(bad, jnp.float32(jnp.nan), jnp.float32(1.0))
-            grads = jax.tree.map(lambda g: g * poison.astype(g.dtype), grads)
-            loss = loss * poison.astype(loss.dtype)
-        # one fused predicate: loss AND global grad norm finite. Computed
-        # on device; no host readback here (the caller fetches the guard
-        # counters only where it already fetches the loss).
-        ok = jnp.isfinite(loss) & jnp.isfinite(optax.global_norm(grads))
+            if nan_stage is None:
+                poison = jnp.where(bad, jnp.float32(jnp.nan),
+                                   jnp.float32(1.0))
+                grads = jax.tree.map(lambda g: g * poison.astype(g.dtype),
+                                     grads)
+                loss = loss * poison.astype(loss.dtype)
+            else:
+                # stage-targeted fault: poison only that stage's layer
+                # rows and leave the loss finite — ONLY the per-stage
+                # reduction can catch and attribute it. Multiplicative
+                # (NaN*g) like the global path, not a select: a
+                # where(mask, nan, g) per leaf interacts pathologically
+                # with XLA:CPU's fusion when max-reductions consume the
+                # result (observed 140s vs 50s compiles on the smoke
+                # config).
+                lps = cfg.n_layers // n_stages
+                in_stage = (jnp.arange(cfg.n_layers) // lps) == nan_stage
+                row = jnp.where(bad & in_stage, jnp.float32(jnp.nan),
+                                jnp.float32(1.0))
+
+                def poison_layer(g):
+                    m = row.reshape((cfg.n_layers,) + (1,) * (g.ndim - 1))
+                    return g * m.astype(g.dtype)
+
+                grads = dict(grads, layers=jax.tree.map(
+                    poison_layer, grads["layers"]))
+        # fused per-stage predicate: loss finite AND every stage's grads
+        # finite. Computed on device; no host readback here (the caller
+        # fetches the guard counters only where it already fetches the
+        # loss). The per-stage counts replace the old all-or-nothing
+        # global-norm isfinite — same verdict, now attributable.
+        nf = nonfinite_per_stage(cfg.n_layers, n_stages, grads)
+        loss_ok = jnp.isfinite(loss)
+        stage_ok = nf == 0
+        grads_ok = stage_ok.all()
+        ok = loss_ok & grads_ok
+        first_bad = jnp.where(
+            grads_ok,
+            jnp.where(loss_ok, jnp.int32(-1), jnp.int32(-2)),
+            jnp.argmax(~stage_ok).astype(jnp.int32))
         updates, new_opt = optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
+        dyn = (dyn_stats(grads, params, updates, sq_mb)
+               if dcfg is not None else None)
 
         def keep(new, old):
             return jnp.where(ok, new, old)
@@ -137,7 +238,11 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             "total": guard_state["total"] + anom,
             "last_anomaly_step": jnp.where(
                 ok, guard_state["last_anomaly_step"], step),
+            "last_bad_stage": jnp.where(
+                ok, guard_state["last_bad_stage"], first_bad),
         }
+        if dcfg is not None:
+            return params, opt_state, loss, guard_state, dyn
         return params, opt_state, loss, guard_state
 
     if cfg.dropout > 0.0:
@@ -304,7 +409,8 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         keep_last: Optional[int] = None,
         guard=None, fault_plan=None,
         handle_preemption: bool = False,
-        stall_timeout_s: Optional[float] = None):
+        stall_timeout_s: Optional[float] = None,
+        dynamics=None):
     """Training loop over a ``(tokens, targets)`` iterator.
 
     Returns (params, list of (step, loss)). The data contract matches the
@@ -373,6 +479,19 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
     - ``fault_plan`` (``resilience.FaultPlan``) injects deterministic
       faults — NaN grads, data-iterator failure, kill-during-save,
       simulated preemption — for the resilience tests and smoke.
+
+    Training dynamics (docs/observability.md §7; opt-in, off by default):
+
+    - ``dynamics`` (``True`` or a ``dynamics.DynamicsConfig``): per-stage /
+      per-layer gradient statistics computed inside the jitted step and
+      read only at log points (riding the loss sync — zero extra syncs), a
+      gradient-noise-scale estimate from the per-microbatch squared norms
+      the pipeline accumulates anyway, a host-side ring buffer of recent
+      step stats + batch digests, and — on an anomaly or a z-score loss
+      spike — a forensic bundle written next to the manifest (requires
+      ``report_dir``). With ``guard`` set, skipped steps additionally emit
+      an ``anomaly_attributed`` event naming the first non-finite stage.
+      ``dynamics=None`` (default) leaves the compiled step byte-identical.
     """
     from .resilience import (AnomalyBudgetExceeded, AnomalyGuard,
                              CheckpointManager, PreemptionHandler,
@@ -387,13 +506,15 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
         optimizer = adamw(total_steps=max(1, num_steps // grad_accum))
     if grad_accum > 1:
         optimizer = optax.MultiSteps(optimizer, every_k_schedule=grad_accum)
+    dcfg = as_dynamics_config(dynamics)
     step_fn = make_train_step(cfg, mesh, sched, optimizer, moe=moe,
                               sp_attn_impl=sp_attn_impl,
                               tp_vocab_parallel=tp_vocab_parallel,
                               fsdp=fsdp, remat_backward=remat_backward,
                               unroll_ticks=unroll_ticks,
                               telemetry=telemetry,
-                              guard=guard, fault_plan=fault_plan)
+                              guard=guard, fault_plan=fault_plan,
+                              dynamics=dcfg)
     report = None
     if report_dir is not None:
         from .telemetry import RunReport
@@ -462,6 +583,28 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
 
     guard_state = init_guard_state(start_step) if guard is not None else None
     guard_seen = 0  # anomalies already surfaced (host high-water mark)
+
+    # Training-dynamics host state: GNS estimator over the per-microbatch
+    # squared norms, ring buffer + spike detector, and the latest device
+    # stats (fetched only at log syncs). All None when dynamics is off.
+    gns_est = None
+    recorder = None
+    dyn_latest = None  # device-resident stats from the newest step
+    dyn_host = None    # host copy fetched at the last log sync
+    n_skipped_attributed = 0
+    if dcfg is not None:
+        from .dynamics import (GNSEstimator, ForensicRecorder, batch_digest,
+                               dynamics_section)
+        recorder = ForensicRecorder(out_dir=report_dir, ring=dcfg.ring,
+                                    spike_z=dcfg.spike_z,
+                                    warmup=dcfg.spike_warmup)
+
+    def _checkpoint_pointer():
+        """Last committed checkpoint step/path for forensic bundles."""
+        if mgr is None:
+            return None
+        s = mgr.stats()
+        return {k: s[k] for k in ("last_committed_step",) if k in s}
 
     # Per-step dropout keys fold the step index from one base key, so a
     # resumed run draws the same masks the uninterrupted run would have.
@@ -552,6 +695,14 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                     optimizer_slots=2, telemetry=telemetry))
             except Exception as e:
                 report.event("memory_model_error", error=str(e))
+        if dcfg is not None:
+            report.attach_dynamics(dynamics_section(
+                mesh.shape[PIPE_AXIS] * sched.n_virtual,
+                last_stats=dyn_host,
+                gns=gns_est.value() if gns_est is not None else None,
+                gns_updates=gns_est.n_updates if gns_est is not None else 0,
+                n_skipped_attributed=n_skipped_attributed,
+                forensic_bundles=recorder.bundles))
         res = {}
         if mgr is not None:
             res.update(mgr.stats())
@@ -589,6 +740,9 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                 tokens, targets = next(data)
                 if data_shape is None:
                     data_shape = (int(tokens.shape[0]), int(tokens.shape[1]))
+                if recorder is not None:
+                    # inputs are host-visible already — hashing adds no sync
+                    recorder.note_batch(i, batch_digest(tokens, targets))
                 # first executed step = trace + compile + run; the report's
                 # compile_s timer brackets it (forced, so the timer is honest)
                 first = report is not None and i == start_step
@@ -597,9 +751,14 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                     args = (params, opt_state, tokens, targets)
                     if drop_key is not None:
                         args += (jax.random.fold_in(drop_key, i),)
-                    if guard_state is not None:
+                    if guard_state is not None and dcfg is not None:
+                        (params, opt_state, loss, guard_state,
+                         dyn_latest) = step_fn(*args, guard_state)
+                    elif guard_state is not None:
                         params, opt_state, loss, guard_state = step_fn(
                             *args, guard_state)
+                    elif dcfg is not None:
+                        params, opt_state, loss, dyn_latest = step_fn(*args)
                     else:
                         params, opt_state, loss = step_fn(*args)
                     if first:
@@ -626,6 +785,55 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                                      tokens_per_sec=round(window_tokens / elapsed,
                                                           2),
                                      elapsed_s=round(elapsed, 4))
+                    if dyn_latest is not None:
+                        # same program as the loss just fetched — this read
+                        # rides that sync, it does not add one
+                        dyn_host = jax.device_get(dyn_latest)
+                        if (dyn_host.get("sq_mb") is not None
+                                and data_shape is not None):
+                            if gns_est is None:
+                                nd = dict(mesh.shape).get("data", 1)
+                                toks = data_shape[0] * data_shape[1]
+                                small = toks / (nd * sched.n_microbatches)
+                                if small < toks:  # M*data==1: no norm pair
+                                    gns_est = GNSEstimator(
+                                        batch_small=small, batch_big=toks,
+                                        ema=dcfg.ema)
+                            if gns_est is not None:
+                                gns_est.update(
+                                    float(dyn_host["sq_mb"].mean()),
+                                    float(dyn_host["grad_norm"]) ** 2)
+                        gns_val = (gns_est.value() if gns_est is not None
+                                   else None)
+                        if report is not None:
+                            report.event(
+                                "dynamics", step=i,
+                                grad_norm=float(dyn_host["grad_norm"]),
+                                grad_norm_per_stage=[
+                                    float(x) for x in
+                                    dyn_host["grad_norm_per_stage"]],
+                                nonfinite_per_stage=[
+                                    int(x) for x in
+                                    dyn_host["nonfinite_per_stage"]],
+                                gns=gns_val)
+                        spike_z = recorder.observe(i, loss_f, stats=dyn_host,
+                                                   gns=gns_val)
+                        if spike_z is not None:
+                            path = recorder.dump(
+                                i, "loss_spike", loss=loss_f, z=spike_z,
+                                stats={k: v for k, v in dyn_host.items()
+                                       if k != "sq_mb"},
+                                checkpoint=_checkpoint_pointer())
+                            if verbose:
+                                print(f"step {i}: loss spike (z={spike_z:.1f})"
+                                      + (f" — forensics at {path}"
+                                         if path else ""), flush=True)
+                            if report is not None:
+                                report.count("loss_spikes")
+                                report.event("loss_spike", step=i,
+                                             loss=loss_f,
+                                             z=round(float(spike_z), 2),
+                                             bundle=path)
                     if guard_state is not None:
                         # the counters were computed by the same program as the
                         # loss just fetched — this read rides that sync, it
@@ -635,17 +843,49 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                         if gs["total"] > guard_seen:
                             delta = gs["total"] - guard_seen
                             guard_seen = gs["total"]
+                            bad = gs.get("last_bad_stage", -1)
+                            where = (f" in stage {bad}" if bad >= 0
+                                     else " (loss only)" if bad == -2 else "")
                             if verbose:
                                 print(f"step {i}: anomaly guard skipped {delta} "
                                       f"step(s) (total {gs['total']}, last at "
-                                      f"step {gs['last_anomaly_step']})",
+                                      f"step {gs['last_anomaly_step']}{where})",
                                       flush=True)
                             if report is not None:
                                 report.count("anomalies", delta)
                                 report.event(
                                     "anomaly", step=i, total=gs["total"],
                                     consec=gs["consec"],
-                                    last_anomaly_step=gs["last_anomaly_step"])
+                                    last_anomaly_step=gs["last_anomaly_step"],
+                                    last_bad_stage=bad)
+                            if dcfg is not None:
+                                # explainable verdict: which stage first went
+                                # non-finite, and on what statistic
+                                n_skipped_attributed += delta
+                                statistic = ("nonfinite_grad" if bad >= 0
+                                             else "nonfinite_loss")
+                                attribution = {
+                                    "stage": bad, "statistic": statistic,
+                                    "last_anomaly_step":
+                                        gs["last_anomaly_step"]}
+                                if dyn_host is not None:
+                                    attribution["nonfinite_per_stage"] = [
+                                        int(x) for x in
+                                        dyn_host["nonfinite_per_stage"]]
+                                if report is not None:
+                                    report.event("anomaly_attributed", step=i,
+                                                 **attribution)
+                                path = recorder.dump(
+                                    i, "anomaly", loss=loss_f,
+                                    stats=None if dyn_host is None else {
+                                        k: v for k, v in dyn_host.items()
+                                        if k != "sq_mb"},
+                                    attribution=attribution,
+                                    checkpoint=_checkpoint_pointer())
+                                if report is not None and path is not None:
+                                    report.event("forensic_bundle", step=i,
+                                                 trigger="anomaly",
+                                                 bundle=path)
                         if gs["consec"] >= guard.max_consecutive:
                             # params/opt_state are the last GOOD state — every
                             # anomalous update was selected away in the step

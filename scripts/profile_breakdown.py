@@ -316,17 +316,69 @@ def memory_breakdown(mem: dict) -> None:
                       f"{pd.get('n_samples', 0)} samples")
 
 
+def dynamics_breakdown(dyn: dict) -> None:
+    """Print a manifest's ``dynamics`` section: the final per-stage
+    gradient-health table (norm, max-|g|, non-finite leaf-rows, param
+    RMS, update ratio when present), the gradient-noise-scale estimate,
+    attributed skips, and any forensic bundles dumped next to the
+    manifest (utils.dynamics; docs/observability.md §7). Numeric cells
+    may arrive as repr strings (NaN-safe serialization) — rendered
+    verbatim."""
+    print(f"\n--- dynamics: {dyn.get('n_stages', '?')} stages ---")
+
+    def _g(v, width=10):
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return f"{str(v):>{width}s}" if v is not None else f"{'n/a':>{width}s}"
+        return f"{v:{width}.4g}"
+
+    print(f"grad norm (final sync) {_g(dyn.get('grad_norm_final'))}   "
+          f"GNS {_g(dyn.get('gns'))} over {dyn.get('gns_updates', 0)} "
+          f"update(s)   attributed skips "
+          f"{dyn.get('n_skipped_attributed', 0)}")
+    rows = dyn.get("per_stage") or []
+    if rows:
+        has_rms = any("param_rms" in r for r in rows)
+        has_ur = any("update_ratio" in r for r in rows)
+        hdr = f"{'stage':>6s} {'|grad|':>10s} {'max|g|':>10s} {'nonfin':>7s}"
+        if has_rms:
+            hdr += f" {'prm RMS':>10s}"
+        if has_ur:
+            hdr += f" {'upd/wt':>10s}"
+        print(hdr)
+        for r in rows:
+            line = (f"{r.get('stage', -1):6d} {_g(r.get('grad_norm'))} "
+                    f"{_g(r.get('grad_max'))} {r.get('nonfinite', 0):7d}")
+            if has_rms:
+                line += f" {_g(r.get('param_rms'))}"
+            if has_ur:
+                line += f" {_g(r.get('update_ratio'))}"
+            print(line)
+    bundles = dyn.get("forensic_bundles") or []
+    for b in bundles:
+        print(f"forensic bundle: {b}")
+
+
 def report_breakdown(manifest: dict) -> None:
-    """Print the telemetry + cost_model sections of a run-report manifest:
-    phase/tick timeline, per-stage F/B/W/idle attribution, predicted vs
-    measured roofline. Pure host-side — works on any machine with just
-    the JSON in hand. Degrades gracefully: missing sections are skipped
-    with a note; a report with neither section exits with a clear
-    message instead of a traceback."""
+    """Print the telemetry + cost_model (+ memory, + dynamics) sections
+    of a run-report manifest: phase/tick timeline, per-stage F/B/W/idle
+    attribution, predicted vs measured roofline, HBM accounting, and the
+    training-dynamics gradient-health table. Pure host-side — works on
+    any machine with just the JSON in hand. Degrades gracefully: missing
+    sections are skipped with a note; a report with neither a telemetry
+    nor a cost_model section exits with a clear message instead of a
+    traceback."""
     meta = manifest.get("meta", {})
     tel = manifest.get("telemetry")
     cm = manifest.get("cost_model")
     if not tel and not cm:
+        # a dynamics-only report (fit with dynamics=True but no
+        # PipelineTelemetry) still has a health table worth printing
+        dyn = manifest.get("dynamics")
+        if isinstance(dyn, dict):
+            print(f"=== run report: {meta.get('name', '?')} "
+                  f"(backend={meta.get('backend', '?')}) ===")
+            dynamics_breakdown(dyn)
+            return
         raise SystemExit(
             "report has neither a 'telemetry' nor a 'cost_model' section — "
             "the run was not instrumented (pass a PipelineTelemetry into "
@@ -370,6 +422,9 @@ def report_breakdown(manifest: dict) -> None:
     mem = manifest.get("memory")
     if isinstance(mem, dict):
         memory_breakdown(mem)
+    dyn = manifest.get("dynamics")
+    if isinstance(dyn, dict):
+        dynamics_breakdown(dyn)
 
 
 def main():

@@ -17,6 +17,15 @@ the same (name, backend, schedule) group:
   HBM guard: a schedule or remat change that silently inflates memory
   fails here before it OOMs a real chip.
 
+Model-health metrics from the report's ``dynamics`` section (or sweep
+gauges) — ``grad_norm_final`` and ``gns`` — get WARN-only two-sided
+*drift* guards (``--drift-threshold``, default 50% either way): they
+are expected to move across legitimate changes (init, data, LR), so a
+drift never fails the run, but two runs of "the same" config quietly
+diverging prints a warning naming the metric. An empty or missing
+history file, a torn tail line, and single-sample groups are all fine:
+the first run of a group establishes the baseline and always passes.
+
 CPU-proxy runs (backend == "cpu") are always warn-only: a simulated-CPU
 host serializes every "parallel" tick, so its wall-clock jitters with
 machine load and a hard gate would flake (docs/results.md §2). Pass
@@ -36,6 +45,7 @@ Usage::
 
 import argparse
 import json
+import math
 import os
 import sys
 import time
@@ -48,6 +58,14 @@ def _get(d, *path):
             return None
         d = d.get(key)
     return d
+
+
+def _num(x):
+    """Finite number or None (dynamics sections serialize NaN losses as
+    repr strings; json may also yield literal NaN floats)."""
+    if isinstance(x, bool) or not isinstance(x, (int, float)):
+        return None
+    return float(x) if math.isfinite(x) else None
 
 
 def extract_metrics(manifest) -> dict:
@@ -75,6 +93,9 @@ def extract_metrics(manifest) -> dict:
             "measured_step_s": None,
             "peak_temp_bytes": None,
             "peak_live_bytes": None,
+            "grad_norm_final": None,
+            "gns": None,
+            "n_skipped_attributed": None,
         }
     gauges = manifest.get("gauges") or {}
     cm = manifest.get("cost_model")
@@ -98,6 +119,18 @@ def extract_metrics(manifest) -> dict:
     mem = manifest.get("memory")
     peak_temp = _get(mem, "compiled", "temp_bytes")
     peak_live = _get(mem, "live", "peak_bytes_in_use")
+    # model-health metrics: the fit manifest's dynamics section, else the
+    # sweep-row gauges (both carry the same column names)
+    dyn = manifest.get("dynamics")
+    grad_norm_final = _num(_get(dyn, "grad_norm_final"))
+    if grad_norm_final is None:
+        grad_norm_final = _num(gauges.get("grad_norm_final"))
+    gns = _num(_get(dyn, "gns"))
+    if gns is None:
+        gns = _num(gauges.get("gns"))
+    n_skipped = _get(dyn, "n_skipped_attributed")
+    if n_skipped is None:
+        n_skipped = gauges.get("n_skipped_attributed")
     return {
         "t": time.time(),
         "name": _get(manifest, "meta", "name") or "unknown",
@@ -113,10 +146,19 @@ def extract_metrics(manifest) -> dict:
         "measured_step_s": _get(cm, "measured", "step_s"),
         "peak_temp_bytes": peak_temp,
         "peak_live_bytes": peak_live,
+        "grad_norm_final": grad_norm_final,
+        "gns": gns,
+        "n_skipped_attributed": (int(n_skipped)
+                                 if isinstance(n_skipped, (int, float))
+                                 else None),
     }
 
 
 def load_history(path):
+    """History rows (missing file -> []). Torn tail lines and rows that
+    are not JSON objects (a hand-edited file, a stray string) are dropped
+    rather than crashing the sentinel — history is best-effort evidence,
+    not a source of truth."""
     rows = []
     if os.path.exists(path):
         with open(path) as fh:
@@ -124,9 +166,11 @@ def load_history(path):
                 line = line.strip()
                 if line:
                     try:
-                        rows.append(json.loads(line))
+                        row = json.loads(line)
                     except json.JSONDecodeError:
-                        pass  # a torn tail line never blocks the sentinel
+                        continue  # a torn tail line never blocks the sentinel
+                    if isinstance(row, dict):
+                        rows.append(row)
     return rows
 
 
@@ -136,13 +180,17 @@ def _median(xs):
     return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
 
 
-def check(row, history, threshold, window) -> list:
-    """Regression messages for ``row`` vs the same group's history."""
+def _group(row, history, window):
     group = [r for r in history
              if r.get("name") == row["name"]
              and r.get("backend") == row["backend"]
              and r.get("schedule") == row["schedule"]]
-    group = group[-window:]
+    return group[-window:]
+
+
+def check(row, history, threshold, window) -> list:
+    """Regression messages for ``row`` vs the same group's history."""
+    group = _group(row, history, window)
     if not group:
         return []
     problems = []
@@ -151,8 +199,10 @@ def check(row, history, threshold, window) -> list:
                            ("peak_live_bytes", "up")):
         val = row.get(key)
         prior = [r[key] for r in group
-                 if isinstance(r.get(key), (int, float))]
-        if val is None or not prior:
+                 if isinstance(r.get(key), (int, float))
+                 and not isinstance(r.get(key), bool)]
+        if not isinstance(val, (int, float)) or isinstance(val, bool) \
+                or not prior:
             continue
         base = _median(prior)
         if direction == "down" and val < base * (1.0 - threshold):
@@ -167,6 +217,31 @@ def check(row, history, threshold, window) -> list:
     return problems
 
 
+DRIFT_KEYS = ("grad_norm_final", "gns")
+
+
+def drift_check(row, history, drift_threshold, window) -> list:
+    """WARN-only two-sided drift messages for the model-health metrics:
+    ``|val - median| > drift_threshold * max(|median|, eps)``. Never
+    gates — training dynamics legitimately move when the run changes —
+    but silent divergence between "identical" runs becomes visible."""
+    group = _group(row, history, window)
+    msgs = []
+    for key in DRIFT_KEYS:
+        val = _num(row.get(key))
+        prior = [v for v in (_num(r.get(key)) for r in group)
+                 if v is not None]
+        if val is None or not prior:
+            continue
+        base = _median(prior)
+        tol = drift_threshold * max(abs(base), 1e-12)
+        if abs(val - base) > tol:
+            msgs.append(
+                f"{key} drifted: {val:.6g} vs median {base:.6g} of "
+                f"{len(prior)} prior run(s) (±{drift_threshold:.0%})")
+    return msgs
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--report", action="append", required=True,
@@ -176,6 +251,9 @@ def main(argv=None) -> int:
                     help="relative regression tolerance (default 0.1)")
     ap.add_argument("--window", type=int, default=20,
                     help="prior runs per group the median is taken over")
+    ap.add_argument("--drift-threshold", type=float, default=0.5,
+                    help="two-sided WARN band for grad_norm_final/gns "
+                         "drift (default 0.5 = ±50%%; never fails)")
     ap.add_argument("--warn-only", action="store_true",
                     help="report regressions but exit 0")
     args = ap.parse_args(argv)
@@ -215,6 +293,9 @@ def main(argv=None) -> int:
                       file=sys.stderr if not soft else sys.stdout)
             if not soft:
                 rc = 1
+        for p in drift_check(row, history, args.drift_threshold,
+                             args.window):
+            print(f"regress: WARN (drift): {label}: {p}")
         new_rows.append(row)
         history.append(row)
 

@@ -11,14 +11,19 @@ it before the suite; CI uploads the resulting report as an artifact):
 - run C: resume over B's checkpoint dir — must fall back past the shell
   to the newest committed step and finish with params **bitwise equal**
   to run A's (same deterministic data stream);
-- run D: anomaly guard + injected NaN grads at step 3 + simulated
+- run D: anomaly guard + dynamics observatory + a stage-targeted NaN
+  fault (``FaultPlan(nan_grad_steps=(3,), nan_grad_stage=1)`` — only
+  stage 1's layer grads are poisoned, the loss stays finite) + simulated
   preemption at step 6, with a ``RunReport`` — the skipped step and the
-  preemption must land in validated report counters.
+  preemption must land in validated report counters, the
+  ``anomaly_attributed`` event must name the injected stage, and a
+  schema-valid forensic bundle must sit next to the manifest.
 
-Writes run D's ``report.json`` (+ ``events.jsonl``) into the output
-directory (argv[1], default ``/tmp/resilience_smoke``) and exits 0 on
-success, 1 with a reason on any violation. A few tiny-model pipeline
-compiles: target a couple of minutes on a CI host.
+Writes run D's ``report.json`` (+ ``events.jsonl`` + any
+``forensics_*.json`` bundles) into the output directory (argv[1],
+default ``/tmp/resilience_smoke``) and exits 0 on success, 1 with a
+reason on any violation. A few tiny-model pipeline compiles: target a
+couple of minutes on a CI host.
 """
 
 import os
@@ -64,7 +69,7 @@ def main() -> int:
     sched = dtpp.ScheduleConfig(name="1F1B", n_microbatches=4)
 
     def run(ckpt, *, resume=False, fault_plan=None, guard=None,
-            report_dir=None, handle_preemption=False):
+            report_dir=None, handle_preemption=False, dynamics=None):
         params = tfm.transformer_init(jax.random.key(0), cfg)
         data = train.synthetic_data(cfg, 4, 8, seed=0)
         return train.fit(cfg, mesh, sched, params, data, STEPS,
@@ -72,7 +77,8 @@ def main() -> int:
                          checkpoint_dir=ckpt, checkpoint_every=2,
                          keep_last=2, resume=resume, fault_plan=fault_plan,
                          guard=guard, report_dir=report_dir,
-                         handle_preemption=handle_preemption)
+                         handle_preemption=handle_preemption,
+                         dynamics=dynamics)
 
     work = tempfile.mkdtemp(prefix="resilience_smoke_")
     try:
@@ -127,9 +133,12 @@ def main() -> int:
             return 1
 
         ckpt_d = os.path.join(work, "d")
+        NAN_STAGE = 1  # stage-targeted fault: loss stays finite, only the
+        #                per-stage reduction can catch and attribute it
         run(ckpt_d, report_dir=out_dir,
-            fault_plan=FaultPlan(nan_grad_steps=(3,), preempt_at_step=6),
-            guard=True, handle_preemption=True)
+            fault_plan=FaultPlan(nan_grad_steps=(3,), nan_grad_stage=NAN_STAGE,
+                                 preempt_at_step=6),
+            guard=True, handle_preemption=True, dynamics=True)
         with open(os.path.join(out_dir, "report.json")) as fh:
             manifest = json.load(fh)
         validate_report(manifest)
@@ -147,11 +156,51 @@ def main() -> int:
             print("resilience_smoke: preempted run left no committed "
                   "checkpoint to resume from", file=sys.stderr)
             return 1
+
+        # explainable-anomaly contract: the attributed event names the
+        # injected stage, and a schema-valid forensic bundle was dumped
+        from distributed_training_with_pipeline_parallelism_tpu.utils.dynamics import (  # noqa: E501
+            validate_forensic_bundle)
+        attributed = []
+        with open(os.path.join(out_dir, "events.jsonl")) as fh:
+            for line in fh:
+                row = json.loads(line)
+                if row.get("kind") == "anomaly_attributed":
+                    attributed.append(row)
+        if not attributed or attributed[0].get("stage") != NAN_STAGE:
+            print(f"resilience_smoke: anomaly_attributed events "
+                  f"{attributed} do not name the injected stage "
+                  f"{NAN_STAGE}", file=sys.stderr)
+            return 1
+        if attributed[0].get("statistic") != "nonfinite_grad":
+            print(f"resilience_smoke: attribution statistic is "
+                  f"{attributed[0].get('statistic')!r}, expected "
+                  "'nonfinite_grad'", file=sys.stderr)
+            return 1
+        dyn = manifest.get("dynamics", {})
+        bundles = dyn.get("forensic_bundles", [])
+        if not bundles:
+            print(f"resilience_smoke: no forensic bundle in the manifest "
+                  f"(dynamics={dyn})", file=sys.stderr)
+            return 1
+        with open(os.path.join(out_dir, bundles[0])) as fh:
+            bundle = json.load(fh)
+        validate_forensic_bundle(bundle)
+        if (bundle.get("attribution") or {}).get("stage") != NAN_STAGE:
+            print(f"resilience_smoke: forensic bundle attribution "
+                  f"{bundle.get('attribution')} does not name stage "
+                  f"{NAN_STAGE}", file=sys.stderr)
+            return 1
+        if dyn.get("n_skipped_attributed", 0) < 1:
+            print(f"resilience_smoke: dynamics section reports no "
+                  f"attributed skips (dynamics={dyn})", file=sys.stderr)
+            return 1
     finally:
         shutil.rmtree(work, ignore_errors=True)
 
     print(f"resilience_smoke: OK — resumed run bit-matches the clean one "
-          f"past an injected kill at step {KILL_STEP}; anomaly + preemption "
+          f"past an injected kill at step {KILL_STEP}; anomaly attributed "
+          f"to the injected stage, forensic bundle validated, preemption "
           f"counters validated, report at "
           f"{os.path.join(out_dir, 'report.json')}")
     return 0

@@ -12,7 +12,12 @@ artifact): build a 4-stage 1F1B step on a simulated CPU mesh with a
 - a ``memory`` section whose analytic per-device activation/grad bytes
   equal the verifier's slot live peaks times the slot slab bytes *to the
   integer*, with XLA's AOT argument accounting reconciled on top,
-- a Perfetto ``trace.json`` that round-trips as valid Chrome-trace JSON,
+- a Perfetto ``trace.json`` that round-trips as valid Chrome-trace JSON
+  (including per-stage training-dynamics counter tracks),
+- a ``dynamics`` section (one instrumented gradient pass: per-stage grad
+  norms + a gradient-noise-scale estimate) that passes the shared schema,
+- the zero-cost-when-off pin: the UNinstrumented gradient program (no
+  telemetry, no dynamics) traces **zero** host callbacks,
 - a ``RunReport`` manifest that passes ``validate_report``.
 
 Writes ``report.json`` (+ ``events.jsonl``, ``trace.json``) into the
@@ -158,13 +163,56 @@ def main() -> int:
               file=sys.stderr)
         return 1
 
+    # model-health layer (docs/observability.md §7): one dynamics-
+    # instrumented gradient pass — per-stage grad norms, a GNS estimate —
+    # attached as the manifest's dynamics section, plus the zero-cost
+    # pin: the uninstrumented program traces ZERO host callbacks
+    from distributed_training_with_pipeline_parallelism_tpu.parallel.pipeline import (
+        make_pipeline_grad_fn)
+    from distributed_training_with_pipeline_parallelism_tpu.utils.dynamics import (
+        GNSEstimator, dynamics_section, stage_stats)
+    plain_grad = make_pipeline_grad_fn(cfg, mesh, sched,
+                                       remat_backward=True,
+                                       unroll_ticks=True)
+    jaxpr_off = str(jax.make_jaxpr(plain_grad)(params, tokens, targets))
+    if "callback" in jaxpr_off:
+        print("telemetry_smoke: uninstrumented grad program traces host "
+              "callbacks — the telemetry/dynamics-off pin is broken",
+              file=sys.stderr)
+        return 1
+    dyn_grad = make_pipeline_grad_fn(cfg, mesh, sched, remat_backward=True,
+                                     unroll_ticks=True, dynamics=True)
+    _, grads_d, sq_mb = dyn_grad(params, tokens, targets)
+    st = stage_stats(cfg.n_layers, 4, grads_d, params=params)
+    est = GNSEstimator(
+        batch_small=tokens.size / sched.n_microbatches,
+        batch_big=float(tokens.size))
+    est.update(float(sq_mb.mean()), float(st["grad_norm"]) ** 2)
+    dyn_sec = dynamics_section(4, last_stats=st, gns=est.value(),
+                               gns_updates=1)
+    report.attach_dynamics(dyn_sec)
+    if any(row["nonfinite"] for row in dyn_sec["per_stage"]):
+        print(f"telemetry_smoke: clean run reports non-finite grads: "
+              f"{dyn_sec['per_stage']}", file=sys.stderr)
+        return 1
+    dyn_events = [{"t": 0.0, "kind": "dynamics",
+                   "grad_norm": dyn_sec["grad_norm_final"],
+                   "grad_norm_per_stage": [row["grad_norm"] for row in
+                                           dyn_sec["per_stage"]],
+                   "gns": dyn_sec["gns"]}]
+
     trace_path = write_perfetto_trace(tel, os.path.join(out_dir,
-                                                        "trace.json"))
+                                                        "trace.json"),
+                                      dynamics_events=dyn_events)
     import json
     with open(trace_path) as fh:
         trace = json.load(fh)
     if not trace.get("traceEvents"):
         print("telemetry_smoke: empty Perfetto trace", file=sys.stderr)
+        return 1
+    if not trace.get("otherData", {}).get("n_dynamics_counters"):
+        print("telemetry_smoke: Perfetto trace has no dynamics counter "
+              "tracks", file=sys.stderr)
         return 1
 
     manifest = report.write()
@@ -173,11 +221,17 @@ def main() -> int:
         print("telemetry_smoke: manifest has no memory section",
               file=sys.stderr)
         return 1
+    if "dynamics" not in manifest:
+        print("telemetry_smoke: manifest has no dynamics section",
+              file=sys.stderr)
+        return 1
     print(f"telemetry_smoke: OK — {len(phases)} phases over "
           f"{cs.table.shape[0]} ticks, bubble(table-exact)="
           f"{sec['predicted']['bubble_table_exact']:.4f}, "
           f"mfu={sec['measured']['mfu']:.2e}, "
           f"mem rel err={rec['argument_rel_err']:.4f}, "
+          f"grad_norm={dyn_sec['grad_norm_final']:.4f}, "
+          f"gns={dyn_sec['gns']}, "
           f"{len(trace['traceEvents'])} trace events, report at "
           f"{os.path.join(out_dir, 'report.json')}")
     return 0

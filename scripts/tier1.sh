@@ -83,7 +83,8 @@ echo "SERVE_SMOKE=ok"
 # Resilience liveness last (own budget): a run killed mid-checkpoint-flush
 # must resume from the last committed step and finish bitwise equal to the
 # uninterrupted run, with anomaly/preemption counters in a validated
-# report. Lands in /tmp/resilience_smoke for CI upload.
+# report and the stage-attributed anomaly's forensic bundle dumped next
+# to it. Lands in /tmp/resilience_smoke for CI upload (report + bundle).
 if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
     python scripts/resilience_smoke.py /tmp/resilience_smoke; then
   echo "RESILIENCE_SMOKE=fail"

@@ -110,6 +110,15 @@ def main():
     ap.add_argument("--stall-timeout", type=float, default=0.0,
                     help="wall-clock watchdog: log stall diagnostics when no "
                          "step completes for this many seconds (0 disables)")
+    ap.add_argument("--dynamics", action="store_true",
+                    help="training-dynamics observatory: per-stage grad "
+                         "stats, gradient-noise scale, and loss-spike "
+                         "forensics (bundles need --report-dir); stats ride "
+                         "the existing log syncs (docs/observability.md §7)")
+    ap.add_argument("--report-dir", default="",
+                    help="write a structured RunReport (events.jsonl + "
+                         "report.json manifest, plus any forensic bundles) "
+                         "into this dir")
     ap.add_argument("--metrics", default="",
                     help="append per-log-point JSON lines here")
     ap.add_argument("--profile", default="",
@@ -351,7 +360,9 @@ def main():
         guard=(AnomalyGuard(max_consecutive=args.anomaly_budget)
                if args.anomaly_guard else None),
         handle_preemption=args.preemption_safe,
-        stall_timeout_s=args.stall_timeout or None)
+        stall_timeout_s=args.stall_timeout or None,
+        report_dir=args.report_dir or None,
+        dynamics=args.dynamics or None)
     if args.ckpt:
         print(f"checkpoints in {args.ckpt}", flush=True)
     if history:

@@ -247,7 +247,8 @@ def test_nan_step_skipped_bitwise():
         p, s, loss, gs = step(p, s, tok, tgt, gs)
         losses_a.append(loss)
     gs = {k: int(v) for k, v in jax.device_get(gs).items()}
-    assert gs == {"step": 4, "consec": 0, "total": 1, "last_anomaly_step": 2}
+    assert gs == {"step": 4, "consec": 0, "total": 1, "last_anomaly_step": 2,
+                  "last_bad_stage": 0}  # all-stage poison: argmax picks 0
     assert not np.isfinite(float(losses_a[2]))  # the poison was real
 
     # run B: SAME compiled fn, guard clock started past every nan step,

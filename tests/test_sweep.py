@@ -8,7 +8,7 @@ import pytest
 
 from distributed_training_with_pipeline_parallelism_tpu.utils.sweep import (
     compute_speedup_and_efficiency, pivot_throughput, run_all_experiments,
-    run_one_experiment)
+    run_one_experiment, summarize_dynamics)
 from distributed_training_with_pipeline_parallelism_tpu.utils import plotting
 
 
@@ -71,6 +71,61 @@ def test_error_contract():
                              seq_length=8, num_iterations=1, dim=32,
                              vocab_size=64)
     assert "error" in out
+
+
+def test_dynamics_columns_none_when_off(mini_sweep_df):
+    # the model-health columns exist on every row so sweeps with and
+    # without the dynamics probe concatenate cleanly; all-None here
+    for col in ("grad_norm_final", "gns", "n_skipped_attributed"):
+        assert col in mini_sweep_df.columns, col
+        assert mini_sweep_df[col].isna().all()
+    # and an all-None sweep summarizes to an empty frame (schema intact)
+    summ = summarize_dynamics(mini_sweep_df)
+    assert summ.empty
+    assert list(summ.columns) == ["schedule", "n", "grad_norm_final_median",
+                                  "gns_median", "n_skipped_attributed"]
+
+
+def test_dynamics_row():
+    out = run_one_experiment(n_layers=4, n_heads=4, num_devices=2,
+                             schedule_type="1F1B", batch_size=8,
+                             seq_length=16, num_iterations=1, dim=32,
+                             vocab_size=64, n_microbatches=4, dynamics=True)
+    assert "error" not in out
+    assert isinstance(out["grad_norm_final"], float)
+    assert out["grad_norm_final"] > 0
+    assert out["gns"] is not None  # M=4 > 1 -> the GNS estimate ran
+    assert out["n_skipped_attributed"] == 0  # no anomaly guard in a sweep row
+    # run_all_experiments is what stamps the grid keys onto each row
+    summ = summarize_dynamics(pd.DataFrame([{**out, "schedule": "1F1B"}]))
+    assert len(summ) == 1
+    row = summ.iloc[0]
+    assert row["schedule"] == "1F1B" and row["n"] == 1
+    assert row["grad_norm_final_median"] == pytest.approx(
+        out["grad_norm_final"])
+    assert row["gns_median"] == pytest.approx(out["gns"])
+
+
+def test_summarize_dynamics_aggregation():
+    # pure-pandas: rows the probe did not run for are excluded, per-row
+    # missing gns drops out of the median, skips sum per schedule
+    df = pd.DataFrame([
+        {"schedule": "GPipe", "grad_norm_final": 1.0, "gns": 4.0,
+         "n_skipped_attributed": 0},
+        {"schedule": "GPipe", "grad_norm_final": 3.0, "gns": None,
+         "n_skipped_attributed": 2},
+        {"schedule": "1F1B", "grad_norm_final": None, "gns": None,
+         "n_skipped_attributed": None},
+    ])
+    s = summarize_dynamics(df).set_index("schedule")
+    assert list(s.index) == ["GPipe"]  # the all-None 1F1B row is excluded
+    assert s.loc["GPipe", "n"] == 2
+    assert s.loc["GPipe", "grad_norm_final_median"] == pytest.approx(2.0)
+    assert s.loc["GPipe", "gns_median"] == pytest.approx(4.0)
+    assert s.loc["GPipe", "n_skipped_attributed"] == 2
+    # a frame without the columns at all (pre-dynamics sweep artifact)
+    legacy = pd.DataFrame([{"schedule": "GPipe", "throughput": 1.0}])
+    assert summarize_dynamics(legacy).empty
 
 
 def test_plots(mini_sweep_df, tmp_path):
