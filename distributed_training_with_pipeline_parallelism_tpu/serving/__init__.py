@@ -5,6 +5,10 @@ block over the pipe mesh plus a host-side scheduler that admits, retires
 and refills per-slot requests between blocks (ISSUE 7 tentpole).
 :mod:`.bench` — the synthetic Poisson-trace benchmark comparing
 continuous vs static batching.
+:mod:`.loadgen` — seeded workload mixes + offered-load ramp sweeps (the
+SLO observatory's measurement substrate, ISSUE 16).
+:mod:`.slo` — SLO targets, attainment/goodput-under-SLO, and the
+saturation-knee detector over a swept curve.
 
 Re-exports are lazy (same ``_LAZY``/``__getattr__`` pattern as the
 top-level package) so ``import ...serving`` does not pull in jax.
@@ -16,6 +20,13 @@ _LAZY = {
     "ServeResult": ("engine", "ServeResult"),
     "ServingEngine": ("engine", "ServingEngine"),
     "make_serving_step_fn": ("engine", "make_serving_step_fn"),
+    "WORKLOAD_MIXES": ("loadgen", "WORKLOAD_MIXES"),
+    "make_workload": ("loadgen", "make_workload"),
+    "sweep_offered_load": ("loadgen", "sweep_offered_load"),
+    "SLOSpec": ("slo", "SLOSpec"),
+    "find_knee": ("slo", "find_knee"),
+    "slo_attainment": ("slo", "slo_attainment"),
+    "serving_load_section": ("slo", "serving_load_section"),
 }
 
 

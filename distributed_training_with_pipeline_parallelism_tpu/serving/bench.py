@@ -42,6 +42,14 @@ def synth_trace(n_requests: int, *, prompt_lens=(2, 12), out_lens=(2, 16),
     """
     if not 0 < load:
         raise ValueError(f"load must be > 0, got {load}")
+    for name, (lo, hi) in (("prompt_lens", tuple(prompt_lens)),
+                           ("out_lens", tuple(out_lens))):
+        # np.random.randint(lo, hi+1) dies with an opaque "low >= high"
+        # deep inside numpy; loadgen ramps build many traces from user
+        # mixes, so name the bad bound here
+        if lo < 1 or hi < lo:
+            raise ValueError(
+                f"{name} bounds ({lo}, {hi}) invalid: need 1 <= lo <= hi")
     rng = np.random.RandomState(seed)
     plens = rng.randint(prompt_lens[0], prompt_lens[1] + 1, size=n_requests)
     budgets = rng.randint(out_lens[0], out_lens[1] + 1, size=n_requests)
@@ -141,7 +149,9 @@ def run_serve_bench(*, cfg: Optional[ModelConfig] = None, params=None,
                         for c in cont.completions)
     sc, ss = serving_summary(cont), serving_summary(stat)
     for s in (sc, ss):
-        s.pop("occupancy", None)  # keep the JSON row compact
+        # keep the JSON row compact: drop the per-boundary time series
+        s.pop("occupancy", None)
+        s.pop("queue_depth", None)
     row = {
         "bench": "serve",
         "n_slots": n_slots, "n_pipe": mesh.shape["pipe"],

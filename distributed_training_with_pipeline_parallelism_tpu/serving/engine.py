@@ -112,6 +112,20 @@ class Completion:
         return self.first_token_tick - self.arrival
 
     @property
+    def admit_wait_ticks(self) -> float:
+        """Queue wait: ticks between arrival and slot admission. TTFT =
+        admit_wait + service TTFT, so a latency regression is immediately
+        attributable to queueing vs the ring itself."""
+        return self.admit_tick - self.arrival
+
+    @property
+    def service_ttft_ticks(self) -> float:
+        """TTFT excluding queue wait: admission to first banked token —
+        the ring's own latency (prefill visits + D hops), independent of
+        offered load."""
+        return self.first_token_tick - self.admit_tick
+
+    @property
     def tpot_ticks(self) -> Optional[float]:
         n = len(self.tokens)
         if n < 2:
@@ -123,14 +137,21 @@ class Completion:
 class ServeResult:
     """What :meth:`ServingEngine.run` returns: completions in finish
     order, the slot-occupancy timeline sampled at every block boundary
-    (``(tick, n_active_slots)``), total ticks the ring advanced, and the
-    host wall-clock the run took."""
+    (``(tick, n_active_slots)``), the admission-queue depth at the same
+    boundaries (``(tick, n_waiting)`` — arrived but not yet admitted),
+    total ticks the ring advanced, ticks the ring was actually busy, and
+    the host wall-clock the run took. Both time series also carry a
+    ``(tick, 0)`` sample at every idle fast-forward boundary, so
+    time-integrals over the samples account for the skipped span instead
+    of silently interpolating across it."""
     completions: List[Completion]
     occupancy: List[Any]
     ticks: int
     wall_s: float
     n_slots: int
     policy: str
+    queue_depth: List[Any] = dataclasses.field(default_factory=list)
+    busy_ticks: int = 0
 
     @property
     def tokens_out(self) -> int:
@@ -145,8 +166,28 @@ class ServeResult:
         """Emitted tokens per slot-visit — the schedule-quality number
         (1.0 would mean every slot emitted a token on every ring round),
         independent of host/hardware speed. Each slot gets ticks/M
-        visits, so this is tokens_out / ticks."""
+        visits, so this is tokens_out / ticks.
+
+        ``ticks`` includes idle fast-forwarded gaps, so under light load
+        this measures *offered-load* utilization (it deflates toward the
+        arrival rate); :attr:`goodput_busy` is the schedule-quality twin
+        over busy ticks only."""
         return self.tokens_out / self.ticks if self.ticks else 0.0
+
+    @property
+    def goodput_busy(self) -> float:
+        """Emitted tokens per *busy* tick: ``tokens_out / busy_ticks``
+        where ``busy_ticks`` counts only ticks the ring actually
+        advanced through the compiled block (>= 1 live slot at block
+        entry) — idle fast-forwarded gaps are excluded. Under light load
+        :attr:`goodput` is deflated by the gaps between arrivals (it
+        answers "how loaded was the ring"); ``goodput_busy`` answers
+        "how well did the schedule use the ticks it ran" and stays
+        comparable across offered loads. At/over saturation there are no
+        gaps and the two coincide. Busy time is accounted at block
+        granularity (the host only observes block boundaries), so a
+        drained tail inside the final block counts as busy."""
+        return self.tokens_out / self.busy_ticks if self.busy_ticks else 0.0
 
     @property
     def n_failed(self) -> int:
@@ -476,9 +517,11 @@ class ServingEngine:
         self.waiting: deque = deque()
         self.completions: List[Completion] = []
         self.occupancy: List[Any] = []
+        self.queue_depth: List[Any] = []
         self._slot_req: Dict[int, Request] = {}
         self._slot_admit: Dict[int, int] = {}
         self._tick = 0
+        self._busy_ticks = 0
 
     # -- request intake --------------------------------------------------
 
@@ -529,7 +572,9 @@ class ServingEngine:
         if self.report is not None:
             self.report.event("serve_admit", rid=req.rid, slot=slot,
                               tick=self._tick, prompt_len=plen,
-                              budget=req.max_new_tokens)
+                              budget=req.max_new_tokens,
+                              arrival=req.arrival,
+                              wait_ticks=self._tick - req.arrival)
 
     def _scrub_slot(self, slot: int) -> None:
         # a failed admission may have left partial mirror writes: park the
@@ -632,11 +677,17 @@ class ServingEngine:
                     # idle gap before the next arrival: nothing is in
                     # flight (all slots dead => all ring hops dead), so
                     # jumping the tick counter is observationally the
-                    # same as spinning empty blocks
+                    # same as spinning empty blocks. The jump skips the
+                    # block-boundary sampling below, so bank an explicit
+                    # zero sample at the jump target — otherwise
+                    # occupancy/queue-depth time-integrals silently
+                    # interpolate across the idle span.
                     nxt = int(np.ceil(self.pending[0].arrival))
                     self._tick = max(self._tick, nxt)
                     self.host["u"] = np.asarray(self._tick, np.int32)
                     self._dirty.add("u")
+                    self.occupancy.append((self._tick, 0))
+                    self.queue_depth.append((self._tick, 0))
                     continue
             # upload only the leaves the scheduler touched, in one batched
             # transfer, each pinned to its spec so the jitted block sees
@@ -647,13 +698,26 @@ class ServingEngine:
                                       [p.sharding(k) for k in dirty])
                 self.state.update(zip(dirty, vals))
                 self._dirty.clear()
+            tick_before = self._tick
             self.state = p.step(*self.weights, self.state)
             fetched = jax.device_get({k: self.state[k] for k in _HOST_KEYS})
             self.host.update(  # np.array: device_get views can be read-only
                 {k: np.array(v) for k, v in fetched.items()})
             self._tick = int(self.host["u"])
+            # every executed block had >= 1 live slot at entry (the empty
+            # cases break or fast-forward above), so its ticks are busy
+            self._busy_ticks += self._tick - tick_before
             n_active = int((self.host["live"] & ~self.host["finished"]).sum())
             self.occupancy.append((self._tick, n_active))
+            # admission-queue depth at the same boundary: requests that
+            # have arrived by now but hold no slot yet (the waiting deque
+            # plus the pending head the next loop iteration will move)
+            n_wait = len(self.waiting)
+            for r in self.pending:  # arrival-sorted: stop at the future
+                if r.arrival > self._tick:
+                    break
+                n_wait += 1
+            self.queue_depth.append((self._tick, n_wait))
             self._harvest()
             free = [g for g in range(p.n_slots) if g not in self._slot_req]
         else:
@@ -662,12 +726,15 @@ class ServingEngine:
         wall = time.perf_counter() - wall0
         result = ServeResult(completions=self.completions,
                              occupancy=self.occupancy, ticks=self._tick,
-                             wall_s=wall, n_slots=p.n_slots, policy=policy)
+                             wall_s=wall, n_slots=p.n_slots, policy=policy,
+                             queue_depth=self.queue_depth,
+                             busy_ticks=self._busy_ticks)
         if self.report is not None:
             # one event per run with the measured tick rate — the factor
             # the cost model's predicted per-tick time reconciles against
             self.report.event(
                 "serve_run", policy=policy, ticks=result.ticks,
+                busy_ticks=result.busy_ticks,
                 wall_s=round(wall, 4), tokens_out=result.tokens_out,
                 s_per_tick=(round(wall / result.ticks, 6)
                             if result.ticks else None))

@@ -1,0 +1,210 @@
+"""Deterministic open-loop load generation for the serving SLO observatory.
+
+:func:`synth_trace` (``.bench``) replays ONE Poisson trace at one
+offered load — a single operating point. This module turns that into the
+measurement substrate ROADMAP item 1 names: seeded *workload mixes*
+(short-chat / long-doc / mixed prompt- and output-length distributions
+layered on ``synth_trace``'s capacity model) and
+:func:`sweep_offered_load`, which replays a *ramp* of offered loads
+(e.g. 0.3 → 1.3x ring capacity) through the SAME compiled
+:class:`.engine.ServingProgram` and reduces each point to one curve row:
+latency percentiles (TTFT split into admission wait + service), queue
+depth and slot occupancy, goodput / goodput-under-SLO, and the cost
+model's predicted per-tick roofline reconciled against the measured
+``s_per_tick``.
+
+Determinism is load-bearing: every point of a ramp reuses the SAME
+workload seed, so prompt/output lengths are identical across points and
+the exponential arrival gaps scale exactly by ``1/load`` (``RandomState``
+consumes the same draws). Ramping offered load therefore compresses one
+fixed workload's arrival process instead of resampling it — p99 TTFT is
+monotone in offered load by construction, not by luck, which is what
+lets ``scripts/serve_load.py`` assert the curve's shape in CI. The
+open-loop discipline (arrivals never wait for completions) is what makes
+saturation visible at all: a closed loop self-throttles and hides the
+knee (:mod:`.slo` finds it on these curves).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .bench import synth_trace
+from .engine import Request, ServingEngine
+
+# Prompt/output-length bands per named mix, in tokens. Interactive
+# chat: short prompts, mid-length answers. Document tasks: long prompts
+# (summarization-shaped), short outputs. "mixed" blends both streams —
+# the heterogeneous case continuous batching exists for. Bands are
+# deliberately small so they fit the CPU-proxy engines the smoke/CI
+# legs build (prompt_max=12/out_max=16); scale via the overrides in
+# make_workload for real meshes.
+WORKLOAD_MIXES: Dict[str, Dict[str, Any]] = {
+    "short_chat": {"prompt_lens": (2, 6), "out_lens": (6, 16)},
+    "long_doc": {"prompt_lens": (8, 12), "out_lens": (2, 6)},
+    "mixed": {"components": ("short_chat", "long_doc"),
+              "fractions": (0.5, 0.5)},
+}
+
+
+def mean_visits_per_request(prompt_lens: Sequence[int],
+                            out_lens: Sequence[int],
+                            prefill_chunk: int = 1) -> float:
+    """Expected slot visits one request occupies: ``E[ceil(plen/C)] +
+    E[budget]`` under discrete-uniform length bands — the analytic twin
+    of the per-trace sampled mean ``synth_trace`` normalizes load by.
+    The ring serves one slot visit per tick, so capacity is
+    ``1 / mean_visits`` requests per tick regardless of M."""
+    lo_p, hi_p = int(prompt_lens[0]), int(prompt_lens[1])
+    lo_o, hi_o = int(out_lens[0]), int(out_lens[1])
+    plens = np.arange(lo_p, hi_p + 1)
+    visits = float(np.mean(np.ceil(plens / prefill_chunk)))
+    return visits + (lo_o + hi_o) / 2.0
+
+
+def make_workload(n_requests: int, mix: str = "mixed", *,
+                  prefill_chunk: int = 1, load: float = 0.8,
+                  vocab_size: int = 64, seed: int = 0,
+                  mixes: Optional[Dict[str, Dict[str, Any]]] = None
+                  ) -> List[Request]:
+    """A seeded request trace for one named workload mix at one offered
+    load (in units of ring capacity, as ``synth_trace``).
+
+    Leaf mixes are one ``synth_trace`` call with the mix's length bands.
+    Composite mixes (``components`` + ``fractions``) split ``load`` and
+    ``n_requests`` across their component streams — each an independent
+    Poisson process, so the superposition is again Poisson at the
+    summed rate — merge by arrival and renumber rids. Same
+    ``(mix, n_requests, seed)`` => byte-identical trace in any process.
+    """
+    table = mixes if mixes is not None else WORKLOAD_MIXES
+    if mix not in table:
+        raise ValueError(f"unknown workload mix {mix!r} "
+                         f"(have: {sorted(table)})")
+    spec = table[mix]
+    if "components" not in spec:
+        return synth_trace(n_requests, prompt_lens=spec["prompt_lens"],
+                           out_lens=spec["out_lens"],
+                           prefill_chunk=prefill_chunk, load=load,
+                           vocab_size=vocab_size, seed=seed)
+    comps, fracs = spec["components"], spec["fractions"]
+    if len(comps) != len(fracs) or abs(sum(fracs) - 1.0) > 1e-9:
+        raise ValueError(f"mix {mix!r}: fractions {fracs} must match "
+                         "components and sum to 1")
+    merged: List[Request] = []
+    for j, (comp, frac) in enumerate(zip(comps, fracs)):
+        n_j = max(1, int(round(n_requests * frac)))
+        # distinct derived seeds per component; deterministic, and the
+        # per-component stream is identical across ramp points (only
+        # its gaps rescale with load)
+        merged.extend(make_workload(
+            n_j, comp, prefill_chunk=prefill_chunk, load=load * frac,
+            vocab_size=vocab_size, seed=seed + 7919 * (j + 1),
+            mixes=table))
+    merged.sort(key=lambda r: r.arrival)
+    out = []
+    for i, r in enumerate(merged):
+        out.append(Request(rid=i, prompt=list(r.prompt),
+                           max_new_tokens=r.max_new_tokens,
+                           arrival=r.arrival))
+    # open-loop contract from synth_trace: the first request is waiting
+    # when the ring starts
+    if out:
+        out[0] = Request(rid=0, prompt=out[0].prompt,
+                         max_new_tokens=out[0].max_new_tokens, arrival=0.0)
+    return out
+
+
+def _point_row(load: float, summary: Dict[str, Any],
+               predicted_s_per_tick: Optional[float],
+               slo_point: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """One curve row: the headline columns flattened for the manifest /
+    regress / plotting consumers, with the full summary nested."""
+    measured = summary.get("s_per_tick")
+    row: Dict[str, Any] = {
+        "offered_load": float(load),
+        "n_requests": summary.get("n_requests"),
+        "n_failed": summary.get("n_failed"),
+        "ticks": summary.get("ticks"),
+        "busy_ticks": summary.get("busy_ticks"),
+        "tokens_out": summary.get("tokens_out"),
+        "goodput": summary.get("goodput"),
+        "goodput_busy": summary.get("goodput_busy"),
+        "ttft_ticks": summary.get("ttft_ticks"),
+        "tpot_ticks": summary.get("tpot_ticks"),
+        "admit_wait_ticks": summary.get("admit_wait_ticks"),
+        "service_ttft_ticks": summary.get("service_ttft_ticks"),
+        "queue_depth_mean": summary.get("queue_depth_mean"),
+        "queue_depth_max": summary.get("queue_depth_max"),
+        "occupancy_mean": summary.get("occupancy_mean"),
+        "s_per_tick": measured,
+        "predicted_s_per_tick": predicted_s_per_tick,
+        "predicted_over_measured": (
+            predicted_s_per_tick / measured
+            if predicted_s_per_tick and measured else None),
+        "summary": summary,
+    }
+    if slo_point is not None:
+        row["slo"] = slo_point
+    return row
+
+
+def sweep_offered_load(engine: ServingEngine, loads: Sequence[float], *,
+                       mix: str = "mixed", n_requests: int = 24,
+                       seed: int = 0, policy: str = "continuous",
+                       slo=None, hardware=None,
+                       reference_load: Optional[float] = None
+                       ) -> Dict[str, Any]:
+    """Replay a ramp of offered loads through ``engine`` and return the
+    ``serving_load`` manifest section (:mod:`.slo` assembles it): one
+    curve row per point, the saturation knee, the SLOSpec and workload
+    descriptor. The engine's compiled block is reused across the whole
+    ramp — the one-compilation invariant holds sweep-wide (asserted by
+    ``scripts/serve_load.py`` via ``program.step._cache_size()``).
+
+    ``loads`` must be strictly increasing (the section schema enforces
+    it: a shuffled ramp would make the knee meaningless). ``slo`` is an
+    :class:`.slo.SLOSpec` (a default is built when omitted);
+    ``hardware`` an ``analysis.cost_model.HardwareSpec`` for the
+    predicted per-tick roofline column (auto-detected when omitted);
+    ``reference_load`` names the curve point whose p99 TTFT becomes the
+    regression-tracked reference (default: the lowest offered load —
+    the point least exposed to queueing noise)."""
+    from ..analysis.cost_model import serving_cost_model_section
+    from ..utils.telemetry import serving_summary
+    from .slo import SLOSpec, find_knee, serving_load_section, slo_attainment
+
+    loads = [float(x) for x in loads]
+    if len(loads) < 2:
+        raise ValueError(f"a sweep needs >= 2 offered loads, got {loads}")
+    if any(b <= a for a, b in zip(loads, loads[1:])):
+        raise ValueError(f"offered loads must be strictly increasing, "
+                         f"got {loads}")
+    if slo is None:
+        slo = SLOSpec.default_for(engine.program)
+    program = engine.program
+    cfg = program.cfg
+    rows: List[Dict[str, Any]] = []
+    for load in loads:
+        trace = make_workload(n_requests, mix,
+                              prefill_chunk=program.prefill_chunk,
+                              load=load, vocab_size=cfg.vocab_size,
+                              seed=seed)
+        result = engine.run(trace, policy=policy)
+        summary = serving_summary(result)
+        # the roofline's per-tick prediction is load-independent (the
+        # ring rolls every tick); computing it per point pins the
+        # reconciliation to each point's measured s_per_tick
+        cm = serving_cost_model_section(cfg, program.n_stages,
+                                        program.n_slots, summary,
+                                        hardware=hardware)
+        rows.append(_point_row(load, summary,
+                               cm["predicted"]["step_s"],
+                               slo_attainment(result, slo)))
+    knee = find_knee(rows, slo)
+    return serving_load_section(rows, knee, slo, mix=mix,
+                                n_requests=n_requests, seed=seed,
+                                policy=policy,
+                                reference_load=reference_load)

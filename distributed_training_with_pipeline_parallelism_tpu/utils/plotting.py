@@ -199,6 +199,111 @@ def plot_timeline_overlay(name_or_cs, timeline, n_devices: int = None,
     return fig
 
 
+def plot_latency_curve(section, path: Optional[str] = None):
+    """The serving SLO observatory's headline figure: latency vs offered
+    load from a ``serving_load`` manifest section (``serving.loadgen.
+    sweep_offered_load`` / ``scripts/serve_load.py``'s ``curve.json``).
+
+    Left panel: p50/p99 TTFT and the admission-wait p99 against offered
+    load (units of ring capacity), with the SLO's p99 TTFT budget as a
+    horizontal line and the detected saturation knee as a vertical one —
+    the hockey stick and where it breaks the budget, on one axis. Right
+    panel: goodput and goodput-under-SLO, which flatten (then part ways)
+    past the knee. ``section`` is the manifest dict; percentiles missing
+    from a row (empty point) plot as gaps.
+    """
+    plt = _mpl()
+
+    def col(key, pct=None):
+        out = []
+        for row in section.get("curve", []):
+            v = row.get(key)
+            if pct is not None:
+                v = v.get(pct) if isinstance(v, dict) else None
+            out.append(v if isinstance(v, (int, float)) else float("nan"))
+        return out
+
+    loads = [row.get("offered_load") for row in section.get("curve", [])]
+    fig, (ax_l, ax_g) = plt.subplots(1, 2, figsize=(11, 4.2))
+    ax_l.plot(loads, col("ttft_ticks", "p99"), marker="o",
+              color="tab:red", label="TTFT p99")
+    ax_l.plot(loads, col("ttft_ticks", "p50"), marker="o",
+              color="tab:blue", label="TTFT p50")
+    ax_l.plot(loads, col("admit_wait_ticks", "p99"), marker="s",
+              color="tab:orange", linestyle="--", label="admission wait p99")
+    slo = section.get("slo") or {}
+    if isinstance(slo.get("ttft_p99_ticks"), (int, float)):
+        ax_l.axhline(slo["ttft_p99_ticks"], color="gray", linestyle=":",
+                     label=f"SLO p99 budget ({slo['ttft_p99_ticks']:g})")
+    knee = section.get("knee") or {}
+    for ax in (ax_l, ax_g):
+        if isinstance(knee.get("knee_load"), (int, float)):
+            ax.axvline(knee["knee_load"], color="black", linestyle="--",
+                       alpha=0.6,
+                       label=f"knee @ {knee['knee_load']:g} "
+                             f"({knee.get('reason')})")
+        ax.set_xlabel("offered load (x ring capacity)")
+        ax.grid(alpha=0.3)
+    ax_l.set_ylabel("latency (ticks)")
+    ax_l.set_title("tail latency vs offered load")
+    ax_l.legend(fontsize=8)
+    ax_g.plot(loads, col("goodput"), marker="o", color="tab:green",
+              label="goodput (tok/tick)")
+    slo_good = [((row.get("slo") or {}).get("goodput_under_slo")
+                 if isinstance((row.get("slo") or {})
+                               .get("goodput_under_slo"), (int, float))
+                 else float("nan"))
+                for row in section.get("curve", [])]
+    ax_g.plot(loads, slo_good, marker="s", color="tab:purple",
+              linestyle="--", label="goodput under SLO")
+    ax_g.set_ylabel("tokens / tick")
+    ax_g.set_title("goodput vs offered load")
+    ax_g.legend(fontsize=8)
+    fig.tight_layout()
+    if path:
+        fig.savefig(path, dpi=120)
+    return fig
+
+
+def plot_queue_depth(summary, path: Optional[str] = None):
+    """Queue depth and slot occupancy over ticks for one serving run —
+    the open-loop early-warning picture: a queue ramp that precedes the
+    TTFT blow-up by a trace length, against how full the ring's slots
+    are while it builds.
+
+    ``summary`` is a ``serving_summary`` dict (or a ``serving_load``
+    curve row's nested ``summary``) carrying the block-boundary
+    ``queue_depth`` / ``occupancy`` series as ``[[tick, n], ...]``; the
+    ``n_slots`` ceiling is drawn when present. Step-drawn: each sample
+    holds until the next block boundary (the fast-forward boundary
+    samples make idle gaps render as zeros, not interpolated slopes).
+    """
+    plt = _mpl()
+    fig, ax = plt.subplots(figsize=(9, 3.6))
+    for key, color, label in (("queue_depth", "tab:red", "admission queue"),
+                              ("occupancy", "tab:blue", "busy slots")):
+        series = summary.get(key) or []
+        if series:
+            ts = [float(t) for t, _ in series]
+            ns = [int(n) for _, n in series]
+            ax.step(ts, ns, where="post", color=color, label=label)
+    n_slots = summary.get("n_slots")
+    if isinstance(n_slots, (int, float)):
+        ax.axhline(n_slots, color="gray", linestyle=":",
+                   label=f"slot count ({int(n_slots)})")
+    ax.set_xlabel("tick")
+    ax.set_ylabel("requests")
+    ax.set_ylim(bottom=0)
+    ax.set_title(f"queue depth & slot occupancy "
+                 f"({summary.get('policy', '?')} policy)", fontsize=10)
+    ax.grid(alpha=0.3)
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    if path:
+        fig.savefig(path, dpi=120)
+    return fig
+
+
 def plot_throughput_grid(df: pd.DataFrame, path: Optional[str] = None):
     plt = _mpl()
     layer_vals = sorted(df["n_layers"].unique())

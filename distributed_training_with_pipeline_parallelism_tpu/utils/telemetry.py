@@ -603,6 +603,87 @@ def perfetto_request_events(serving_events: List[Dict[str, Any]],
     return out
 
 
+def perfetto_serving_load_events(serving_events: List[Dict[str, Any]],
+                                 occupancy: Optional[List[Any]] = None,
+                                 queue_depth: Optional[List[Any]] = None,
+                                 s_per_tick: Optional[float] = None,
+                                 pid: int = 3) -> List[Dict[str, Any]]:
+    """The serving-load debugging surface on the **tick clock**: per-slot
+    request slices split into *queue wait* vs *execution* sub-spans, plus
+    queue-depth and slot-occupancy counter tracks.
+
+    Rides the same ``serve_admit``/``serve_finish`` RunReport rows as
+    :func:`perfetto_request_events`, but lays everything out in ticks —
+    the exact on-device stamps (``arrival``/``tick`` on the admit row,
+    ``tick`` on the finish row) rather than host wall-clock, so a
+    latency outlier decomposes visually: a long ``wait`` slice is
+    queueing (saturation), a long ``serve`` slice is the ring itself.
+    ``occupancy``/``queue_depth`` are ``(tick, n)`` block-boundary
+    samples (``ServeResult.occupancy``/``.queue_depth``); each becomes a
+    ``"C"`` counter track right under the request rows, so the queue
+    ramp that precedes a TTFT blow-up is on screen with it.
+    ``s_per_tick`` scales ticks to real time when known (1 tick = 1 us
+    otherwise — relative layout is what matters). Admit rows without an
+    ``arrival`` field (pre-SLO-observatory streams) degrade to a
+    zero-width wait slice."""
+    admits: Dict[Any, Dict[str, Any]] = {}
+    finishes: Dict[Any, Dict[str, Any]] = {}
+    for row in serving_events or []:
+        if row.get("kind") == "serve_admit" and "rid" in row:
+            admits[row["rid"]] = row
+        elif row.get("kind") == "serve_finish" and "rid" in row:
+            finishes[row["rid"]] = row
+    if not admits and not occupancy and not queue_depth:
+        return []
+    tick_us = (s_per_tick * 1e6) if s_per_tick else 1.0
+    out: List[Dict[str, Any]] = [{
+        "ph": "M", "name": "process_name", "pid": pid, "tid": 0, "ts": 0.0,
+        "args": {"name": "serving load (ticks)"}}]
+    slots = sorted({int(r.get("slot", 0)) for r in admits.values()})
+    for slot in slots:
+        out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": slot + 1, "ts": 0.0,
+                    "args": {"name": f"slot {slot}"}})
+    for rid, adm in sorted(admits.items(),
+                           key=lambda kv: kv[1].get("tick", 0)):
+        slot = int(adm.get("slot", 0))
+        admit_tick = float(adm.get("tick", 0))
+        arrival = adm.get("arrival")
+        arrival = float(arrival) if isinstance(arrival, (int, float)) \
+            else admit_tick
+        args = {"rid": rid, "slot": slot, "arrival": arrival,
+                "admit_tick": adm.get("tick"),
+                "prompt_len": adm.get("prompt_len"),
+                "budget": adm.get("budget")}
+        if arrival < admit_tick:
+            out.append({"ph": "X", "name": f"wait r{rid}",
+                        "cat": "queue_wait", "pid": pid, "tid": slot + 1,
+                        "ts": arrival * tick_us,
+                        "dur": (admit_tick - arrival) * tick_us,
+                        "args": args})
+        fin = finishes.get(rid)
+        end_tick = (float(fin["tick"]) if fin is not None
+                    and isinstance(fin.get("tick"), (int, float))
+                    else admit_tick)
+        fargs = dict(args)
+        if fin is not None:
+            fargs.update({"finish_tick": fin.get("tick"),
+                          "n_tokens": fin.get("n_tokens"),
+                          "ttft_ticks": fin.get("ttft_ticks")})
+        out.append({"ph": "X", "name": f"serve r{rid}", "cat": "execution",
+                    "pid": pid, "tid": slot + 1,
+                    "ts": admit_tick * tick_us,
+                    "dur": max(end_tick - admit_tick, 0.0) * tick_us,
+                    "args": fargs})
+    for name, series in (("slot occupancy", occupancy),
+                         ("queue depth", queue_depth)):
+        for t, n in series or []:
+            out.append({"ph": "C", "name": name, "cat": "serving_load",
+                        "pid": pid, "tid": 0, "ts": float(t) * tick_us,
+                        "args": {name.replace(" ", "_"): int(n)}})
+    return out
+
+
 def perfetto_dynamics_events(dynamics_events: List[Dict[str, Any]],
                              pid: int = 2) -> List[Dict[str, Any]]:
     """Per-stage grad-norm counter tracks from RunReport ``dynamics``
@@ -647,11 +728,17 @@ def perfetto_dynamics_events(dynamics_events: List[Dict[str, Any]],
 
 def write_perfetto_trace(telemetry: Optional[PipelineTelemetry], path: str,
                          serving_events: Optional[List[Dict[str, Any]]] = None,
-                         dynamics_events: Optional[List[Dict[str, Any]]] = None
+                         dynamics_events: Optional[List[Dict[str, Any]]] = None,
+                         serving_load_tracks: Optional[Dict[str, Any]] = None
                          ) -> str:
     """Serialize :func:`perfetto_trace` to ``path``; returns the path.
     With ``telemetry=None`` (a serving-only run has no pipeline
-    telemetry) the trace holds just the requests/dynamics tracks."""
+    telemetry) the trace holds just the requests/dynamics tracks.
+    ``serving_load_tracks`` (optional) adds the tick-clock serving-load
+    process (:func:`perfetto_serving_load_events`): a dict with any of
+    ``occupancy``/``queue_depth`` (block-boundary ``(tick, n)`` samples)
+    and ``s_per_tick``; the request sub-spans come from
+    ``serving_events``."""
     if telemetry is None:
         rows = perfetto_request_events(serving_events or [])
         rows.extend(perfetto_dynamics_events(dynamics_events or []))
@@ -663,6 +750,12 @@ def write_perfetto_trace(telemetry: Optional[PipelineTelemetry], path: str,
     else:
         trace = perfetto_trace(telemetry, serving_events=serving_events,
                                dynamics_events=dynamics_events)
+    if serving_load_tracks is not None:
+        trace["traceEvents"].extend(perfetto_serving_load_events(
+            serving_events or [],
+            occupancy=serving_load_tracks.get("occupancy"),
+            queue_depth=serving_load_tracks.get("queue_depth"),
+            s_per_tick=serving_load_tracks.get("s_per_tick")))
     with open(path, "w") as fh:
         json.dump(trace, fh)
     return path
@@ -702,23 +795,41 @@ def serving_summary(result) -> Dict[str, Any]:
           if getattr(c, "status", "ok") == "ok"]
     ttfts = [c.ttft_ticks for c in ok]
     tpots = [c.tpot_ticks for c in ok if c.tpot_ticks is not None]
+    # TTFT split: admission wait (admit - arrival, pure queueing) vs
+    # service TTFT (first token - admit, the ring's own latency). Older
+    # ServeResult-likes without the stamps degrade to empty samples.
+    waits = [c.admit_wait_ticks for c in ok
+             if getattr(c, "admit_wait_ticks", None) is not None]
+    service = [c.service_ttft_ticks for c in ok
+               if getattr(c, "service_ttft_ticks", None) is not None]
     occ = [int(n) for _, n in result.occupancy]
+    qd_series = list(getattr(result, "queue_depth", []) or [])
+    qd = [int(n) for _, n in qd_series]
+    busy = getattr(result, "busy_ticks", None)
     return {
         "policy": result.policy,
         "n_requests": len(ok),
         "n_failed": len(result.completions) - len(ok),
         "n_slots": int(result.n_slots),
         "ticks": int(result.ticks),
+        "busy_ticks": int(busy) if busy is not None else None,
         "wall_s": float(result.wall_s),
         "s_per_tick": (float(result.wall_s) / result.ticks
                        if result.ticks else None),
         "tokens_out": int(result.tokens_out),
         "tokens_per_sec": float(result.tokens_per_sec),
         "goodput": float(result.goodput),
+        "goodput_busy": (float(result.goodput_busy)
+                         if hasattr(result, "goodput_busy") else None),
         "ttft_ticks": _pct(ttfts),
         "tpot_ticks": _pct(tpots),
+        "admit_wait_ticks": _pct(waits),
+        "service_ttft_ticks": _pct(service),
         "occupancy_mean": float(np.mean(occ)) if occ else 0.0,
         "occupancy": [[int(t), int(n)] for t, n in result.occupancy],
+        "queue_depth_mean": float(np.mean(qd)) if qd else 0.0,
+        "queue_depth_max": int(max(qd)) if qd else 0,
+        "queue_depth": [[int(t), int(n)] for t, n in qd_series],
     }
 
 
@@ -753,6 +864,7 @@ class RunReport:
         self.events: List[Dict[str, Any]] = []
         self.telemetry: Optional[Dict[str, Any]] = None
         self.serving: List[Dict[str, Any]] = []
+        self.serving_load: Optional[Dict[str, Any]] = None
         self.resilience: Optional[Dict[str, Any]] = None
         self.static_analysis: Optional[Dict[str, Any]] = None
         self.cost_model: Optional[Dict[str, Any]] = None
@@ -814,6 +926,16 @@ class RunReport:
         back attaches both."""
         self.serving.append(summary)
 
+    def attach_serving_load(self, section: Dict[str, Any]) -> None:
+        """Embed an offered-load sweep
+        (:func:`...serving.loadgen.sweep_offered_load` /
+        :func:`...serving.slo.serving_load_section`: latency-vs-load
+        curve rows, the saturation knee, the SLOSpec and the regression
+        reference point) as the manifest's ``serving_load`` block — the
+        record ``scripts/regress.py`` guards ``max_sustainable_load``
+        and reference p99 TTFT from."""
+        self.serving_load = dict(section)
+
     def attach_resilience(self, section: Dict[str, Any]) -> None:
         """Embed the run's resilience summary (anomaly / preemption /
         stall counters, checkpoint-commit stats — assembled by
@@ -873,6 +995,8 @@ class RunReport:
             out["telemetry"] = _jsonable(self.telemetry)
         if self.serving:
             out["serving"] = _jsonable(self.serving)
+        if self.serving_load is not None:
+            out["serving_load"] = _jsonable(self.serving_load)
         if self.resilience is not None:
             out["resilience"] = _jsonable(self.resilience)
         if self.static_analysis is not None:
@@ -996,6 +1120,68 @@ def validate_report(manifest: Dict[str, Any]) -> None:
                          "(p50/p95/p99/mean)")
             if "n_failed" in row and not isinstance(row["n_failed"], int):
                 fail("serving summary n_failed must be an int")
+    sl = manifest.get("serving_load")
+    if sl is not None:
+        if not isinstance(sl, dict):
+            fail("serving_load must be a dict")
+        if not isinstance(sl.get("policy"), str):
+            fail("serving_load.policy must be a string")
+        wl = sl.get("workload")
+        if not isinstance(wl, dict) or not isinstance(
+                wl.get("mix"), str) or not isinstance(
+                wl.get("n_requests"), int):
+            fail("serving_load.workload needs a str 'mix' and int "
+                 "'n_requests'")
+        slo = sl.get("slo")
+        if not isinstance(slo, dict) or not isinstance(
+                slo.get("ttft_p99_ticks"), (int, float)):
+            fail("serving_load.slo needs a numeric ttft_p99_ticks")
+        curve = sl.get("curve")
+        if not isinstance(curve, list) or not curve:
+            fail("serving_load.curve must be a non-empty list")
+        loads = []
+        for row in curve:
+            if not isinstance(row, dict) or not isinstance(
+                    row.get("offered_load"), (int, float)):
+                fail("serving_load curve rows need a numeric "
+                     "'offered_load'")
+            loads.append(float(row["offered_load"]))
+            for key in ("ticks", "tokens_out"):
+                if not isinstance(row.get(key), int):
+                    fail(f"serving_load curve rows need an int {key!r}")
+            for key in ("ttft_ticks", "tpot_ticks"):
+                pct = row.get(key)
+                if not isinstance(pct, dict) or "p99" not in pct:
+                    fail(f"serving_load curve row {key!r} must be a "
+                         "percentile dict carrying p99")
+                if pct["p99"] is not None and not isinstance(
+                        pct["p99"], (int, float)):
+                    fail(f"serving_load curve row {key}.p99 must be a "
+                         "number or null")
+            for key in ("goodput", "queue_depth_mean"):
+                if key in row and row[key] is not None and not isinstance(
+                        row[key], (int, float)):
+                    fail(f"serving_load curve row {key!r} must be numeric")
+        if any(b <= a for a, b in zip(loads, loads[1:])):
+            fail(f"serving_load offered loads must be strictly "
+                 f"increasing, got {loads}")
+        knee = sl.get("knee")
+        if not isinstance(knee, dict) or not isinstance(
+                knee.get("detected"), bool):
+            fail("serving_load.knee must be a dict with a bool 'detected'")
+        for key in ("knee_load", "max_sustainable_load"):
+            v = knee.get(key)
+            if v is not None and not isinstance(v, (int, float)):
+                fail(f"serving_load.knee.{key} must be a number or null")
+        if knee["detected"] and not isinstance(
+                knee.get("knee_load"), (int, float)):
+            fail("serving_load.knee.detected without a numeric knee_load")
+        ref = sl.get("reference")
+        if ref is not None:
+            if not isinstance(ref, dict) or not isinstance(
+                    ref.get("offered_load"), (int, float)):
+                fail("serving_load.reference needs a numeric "
+                     "'offered_load'")
     res = manifest.get("resilience")
     if res is not None:
         if not isinstance(res, dict):

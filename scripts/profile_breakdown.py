@@ -358,6 +358,56 @@ def dynamics_breakdown(dyn: dict) -> None:
         print(f"forensic bundle: {b}")
 
 
+def serving_load_breakdown(sl: dict) -> None:
+    """Print a manifest's ``serving_load`` section: the offered-load
+    latency curve (TTFT split into admission wait + service), SLO
+    attainment and goodput columns, the saturation knee, and the
+    regression reference point (serving.loadgen / serving.slo;
+    docs/serving.md "Load testing & SLOs")."""
+    wl = sl.get("workload") or {}
+    slo = sl.get("slo") or {}
+    print(f"\n--- serving load: mix={wl.get('mix', '?')} "
+          f"n={wl.get('n_requests', '?')} seed={wl.get('seed', '?')} "
+          f"policy={sl.get('policy', '?')} "
+          f"SLO p99 TTFT <= {slo.get('ttft_p99_ticks', '?')} ticks ---")
+
+    def _p(row, key, pct="p99"):
+        v = row.get(key)
+        v = v.get(pct) if isinstance(v, dict) else None
+        return f"{v:9.1f}" if isinstance(v, (int, float)) else f"{'n/a':>9s}"
+
+    def _f(v, width=8, fmt=".3f"):
+        return (f"{v:{width}{fmt}}" if isinstance(v, (int, float))
+                else f"{'n/a':>{width}s}")
+
+    print(f"{'load':>6s} {'ttft p50':>9s} {'ttft p99':>9s} "
+          f"{'wait p99':>9s} {'tpot p99':>9s} {'q max':>6s} "
+          f"{'goodput':>8s} {'slo-good':>8s} {'attain':>7s}")
+    for row in sl.get("curve") or []:
+        att = (row.get("slo") or {}).get("attainment")
+        sg = (row.get("slo") or {}).get("goodput_under_slo")
+        qmax = row.get("queue_depth_max")
+        print(f"{row.get('offered_load', 0.0):6.2f} "
+              f"{_p(row, 'ttft_ticks', 'p50')} {_p(row, 'ttft_ticks')} "
+              f"{_p(row, 'admit_wait_ticks')} {_p(row, 'tpot_ticks')} "
+              f"{qmax if isinstance(qmax, (int, float)) else 'n/a':>6} "
+              f"{_f(row.get('goodput'))} {_f(sg)} "
+              f"{f'{att:.0%}' if isinstance(att, (int, float)) else 'n/a':>7s}")
+    knee = sl.get("knee") or {}
+    if knee.get("detected"):
+        print(f"knee at load {knee.get('knee_load')} "
+              f"({knee.get('reason')}); max sustainable "
+              f"{knee.get('max_sustainable_load')}")
+    else:
+        print("no saturation knee on this ramp (every point sustained "
+              "the SLO — widen it to find the knee)")
+    ref = sl.get("reference") or {}
+    if ref:
+        print(f"reference @ load {ref.get('offered_load')}: p99 TTFT "
+              f"{ref.get('ttft_p99_ticks')} ticks, goodput "
+              f"{ref.get('goodput')} (regression-tracked)")
+
+
 def report_breakdown(manifest: dict) -> None:
     """Print the telemetry + cost_model (+ memory, + dynamics) sections
     of a run-report manifest: phase/tick timeline, per-stage F/B/W/idle
@@ -371,13 +421,18 @@ def report_breakdown(manifest: dict) -> None:
     tel = manifest.get("telemetry")
     cm = manifest.get("cost_model")
     if not tel and not cm:
-        # a dynamics-only report (fit with dynamics=True but no
-        # PipelineTelemetry) still has a health table worth printing
+        # a dynamics- or serving-load-only report (fit with dynamics=True
+        # but no PipelineTelemetry; scripts/serve_load.py's sweep) still
+        # has tables worth printing
         dyn = manifest.get("dynamics")
-        if isinstance(dyn, dict):
+        sl = manifest.get("serving_load")
+        if isinstance(dyn, dict) or isinstance(sl, dict):
             print(f"=== run report: {meta.get('name', '?')} "
                   f"(backend={meta.get('backend', '?')}) ===")
-            dynamics_breakdown(dyn)
+            if isinstance(dyn, dict):
+                dynamics_breakdown(dyn)
+            if isinstance(sl, dict):
+                serving_load_breakdown(sl)
             return
         raise SystemExit(
             "report has neither a 'telemetry' nor a 'cost_model' section — "
@@ -425,6 +480,9 @@ def report_breakdown(manifest: dict) -> None:
     dyn = manifest.get("dynamics")
     if isinstance(dyn, dict):
         dynamics_breakdown(dyn)
+    sl = manifest.get("serving_load")
+    if isinstance(sl, dict):
+        serving_load_breakdown(sl)
 
 
 def main():

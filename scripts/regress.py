@@ -15,7 +15,14 @@ the same (name, backend, schedule) group:
   report's ``memory`` section) or ``peak_live_bytes`` (the sampled
   ``memory_stats()`` watermark) grows by more than the threshold — the
   HBM guard: a schedule or remat change that silently inflates memory
-  fails here before it OOMs a real chip.
+  fails here before it OOMs a real chip,
+- ``max_sustainable_load`` (from the report's ``serving_load`` section:
+  the highest offered load that sustained the SLO before the saturation
+  knee) drops by more than the threshold, or ``serve_ttft_p99_ref``
+  (p99 TTFT in ticks at the sweep's reference load) rises by more than
+  the threshold — the serving SLO guard: a scheduler change that moves
+  the knee left or inflates uncontended tail latency fails here before
+  a deployment notices.
 
 Model-health metrics from the report's ``dynamics`` section (or sweep
 gauges) — ``grad_norm_final`` and ``gns`` — get WARN-only two-sided
@@ -96,6 +103,8 @@ def extract_metrics(manifest) -> dict:
             "grad_norm_final": None,
             "gns": None,
             "n_skipped_attributed": None,
+            "max_sustainable_load": None,
+            "serve_ttft_p99_ref": None,
         }
     gauges = manifest.get("gauges") or {}
     cm = manifest.get("cost_model")
@@ -131,6 +140,12 @@ def extract_metrics(manifest) -> dict:
     n_skipped = _get(dyn, "n_skipped_attributed")
     if n_skipped is None:
         n_skipped = gauges.get("n_skipped_attributed")
+    # serving SLO observatory: the knee's sustainable-load headline and
+    # the reference point's p99 TTFT (ticks — deterministic, so these
+    # gate hard off-cpu unlike the wall-clock numbers)
+    sl = manifest.get("serving_load")
+    max_sustainable = _num(_get(sl, "knee", "max_sustainable_load"))
+    ttft_ref = _num(_get(sl, "reference", "ttft_p99_ticks"))
     return {
         "t": time.time(),
         "name": _get(manifest, "meta", "name") or "unknown",
@@ -151,6 +166,8 @@ def extract_metrics(manifest) -> dict:
         "n_skipped_attributed": (int(n_skipped)
                                  if isinstance(n_skipped, (int, float))
                                  else None),
+        "max_sustainable_load": max_sustainable,
+        "serve_ttft_p99_ref": ttft_ref,
     }
 
 
@@ -196,7 +213,9 @@ def check(row, history, threshold, window) -> list:
     problems = []
     for key, direction in (("tokens_per_sec", "down"), ("mfu", "down"),
                            ("bubble", "up"), ("peak_temp_bytes", "up"),
-                           ("peak_live_bytes", "up")):
+                           ("peak_live_bytes", "up"),
+                           ("max_sustainable_load", "down"),
+                           ("serve_ttft_p99_ref", "up")):
         val = row.get(key)
         prior = [r[key] for r in group
                  if isinstance(r.get(key), (int, float))

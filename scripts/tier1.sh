@@ -80,6 +80,26 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
   exit 1
 fi
 echo "SERVE_SMOKE=ok"
+# Serving SLO observatory next (own budget): a 3-point offered-load ramp
+# through one compiled engine must detect a saturation knee at or below
+# the over-capacity point, keep p99 TTFT monotone (same-seed ramps make
+# that deterministic), hold the one-compilation invariant sweep-wide,
+# and write a validated serving_load section + latency curve + tick-clock
+# Perfetto trace. Lands in /tmp/serve_load for CI upload; the knee's
+# max_sustainable_load and reference p99 TTFT feed the regression
+# history (warn-only — docs/serving.md "Load testing & SLOs").
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python scripts/serve_load.py /tmp/serve_load; then
+  echo "SERVE_LOAD=fail"
+  exit 1
+fi
+if ! timeout -k 10 60 \
+    python scripts/regress.py --report /tmp/serve_load/report.json \
+    --history results/history.jsonl --warn-only; then
+  echo "SERVE_LOAD=fail"
+  exit 1
+fi
+echo "SERVE_LOAD=ok"
 # Resilience liveness last (own budget): a run killed mid-checkpoint-flush
 # must resume from the last committed step and finish bitwise equal to the
 # uninterrupted run, with anomaly/preemption counters in a validated

@@ -1,0 +1,407 @@
+"""Serving SLO observatory (ISSUE 16): seeded workload mixes, offered-
+load sweeps, the saturation-knee detector, the ``serving_load`` manifest
+schema, and the regression-sentinel ingestion of the knee's headline
+numbers. The load-bearing property is DETERMINISM: the same
+``(mix, n_requests, seed)`` must produce a byte-identical trace in any
+process, and a ramp reuses the same seed at every point so arrival gaps
+scale exactly ``1/load`` — which is what makes the CI curve-shape
+assertions (monotone p99 TTFT, knee below the over-capacity point)
+exact statements rather than statistical hopes."""
+
+import hashlib
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import jax
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.models import (
+    transformer as tfm)
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+    make_mesh)
+from distributed_training_with_pipeline_parallelism_tpu.serving import (
+    ServingEngine, SLOSpec, find_knee, make_serving_step_fn, make_workload,
+    serving_load_section, slo_attainment, sweep_offered_load)
+from distributed_training_with_pipeline_parallelism_tpu.serving.loadgen import (
+    WORKLOAD_MIXES, mean_visits_per_request)
+from distributed_training_with_pipeline_parallelism_tpu.utils.telemetry import (
+    RunReport, perfetto_serving_load_events, validate_report)
+
+_REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _load_script(name):
+    """Import a scripts/ module by path (scripts/ is not a package)."""
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _trace_digest(trace) -> str:
+    blob = json.dumps([[r.rid, r.prompt, r.max_new_tokens, r.arrival]
+                       for r in trace]).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Workload mixes: determinism, structure, validation
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mix", sorted(WORKLOAD_MIXES))
+def test_make_workload_deterministic_and_well_formed(mix):
+    a = make_workload(12, mix, prefill_chunk=2, load=0.8, seed=3)
+    b = make_workload(12, mix, prefill_chunk=2, load=0.8, seed=3)
+    assert _trace_digest(a) == _trace_digest(b)
+    assert [r.rid for r in a] == list(range(len(a)))
+    arr = [r.arrival for r in a]
+    assert arr == sorted(arr) and arr[0] == 0.0
+    # a different seed moves the arrivals (same capacity model)
+    c = make_workload(12, mix, prefill_chunk=2, load=0.8, seed=4)
+    assert _trace_digest(a) != _trace_digest(c)
+
+
+def test_make_workload_mix_length_bands():
+    chat = make_workload(16, "short_chat", seed=0)
+    doc = make_workload(16, "long_doc", seed=0)
+    assert all(2 <= len(r.prompt) <= 6 for r in chat)
+    assert all(8 <= len(r.prompt) <= 12 for r in doc)
+    # the composite blend carries both bands
+    mixed = make_workload(16, "mixed", seed=0)
+    assert any(len(r.prompt) <= 6 for r in mixed)
+    assert any(len(r.prompt) >= 8 for r in mixed)
+
+
+def test_make_workload_unknown_mix_and_bad_fractions():
+    with pytest.raises(ValueError, match="unknown workload mix"):
+        make_workload(4, "tail_sampler")
+    with pytest.raises(ValueError, match="sum to 1"):
+        make_workload(4, "broken",
+                      mixes={"short_chat": WORKLOAD_MIXES["short_chat"],
+                             "broken": {"components": ("short_chat",),
+                                        "fractions": (0.7,)}})
+
+
+def test_make_workload_byte_deterministic_across_processes():
+    """Same (mix, n, seed) => byte-identical trace in a FRESH interpreter
+    — the property that lets two CI runs (or a ramp replayed months
+    apart) compare curves at all."""
+    here = _trace_digest(make_workload(10, "mixed", prefill_chunk=2,
+                                       load=0.9, seed=7))
+    prog = (
+        "import hashlib, json, sys\n"
+        f"sys.path.insert(0, {_REPO!r})\n"
+        "from distributed_training_with_pipeline_parallelism_tpu.serving"
+        " import make_workload\n"
+        "t = make_workload(10, 'mixed', prefill_chunk=2, load=0.9, seed=7)\n"
+        "blob = json.dumps([[r.rid, r.prompt, r.max_new_tokens, r.arrival]"
+        " for r in t]).encode()\n"
+        "print(hashlib.sha256(blob).hexdigest())\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", prog], env=env,
+                         capture_output=True, text=True, check=True)
+    assert out.stdout.strip() == here
+
+
+def test_same_seed_ramp_scales_gaps_exactly():
+    """The monotonicity enabler: at two loads, the same seed yields the
+    SAME lengths and arrival gaps scaled exactly by the load ratio."""
+    lo = make_workload(10, "short_chat", load=0.5, seed=1)
+    hi = make_workload(10, "short_chat", load=1.0, seed=1)
+    assert [r.prompt for r in lo] == [r.prompt for r in hi]
+    assert [r.max_new_tokens for r in lo] == [r.max_new_tokens for r in hi]
+    g_lo = np.diff([r.arrival for r in lo])
+    g_hi = np.diff([r.arrival for r in hi])
+    np.testing.assert_allclose(g_lo, 2.0 * g_hi, rtol=1e-12)
+
+
+def test_mean_visits_matches_sampled_mean():
+    spec = WORKLOAD_MIXES["long_doc"]
+    analytic = mean_visits_per_request(spec["prompt_lens"],
+                                       spec["out_lens"], prefill_chunk=2)
+    trace = make_workload(4000, "long_doc", prefill_chunk=2, seed=0)
+    sampled = float(np.mean([np.ceil(len(r.prompt) / 2) + r.max_new_tokens
+                             for r in trace]))
+    assert abs(analytic - sampled) / analytic < 0.02
+
+
+def test_synth_trace_rejects_bad_length_bounds():
+    from distributed_training_with_pipeline_parallelism_tpu.serving.bench import (
+        synth_trace)
+    with pytest.raises(ValueError, match="prompt_lens bounds"):
+        synth_trace(4, prompt_lens=(6, 2))
+    with pytest.raises(ValueError, match="out_lens bounds"):
+        synth_trace(4, out_lens=(0, 4))
+
+
+# ---------------------------------------------------------------------------
+# SLOSpec + knee detector on synthetic curves (no jax execution)
+# ---------------------------------------------------------------------------
+
+
+def _row(load, ttft99, tpot99=3.0, qmax=2):
+    return {"offered_load": load,
+            "ttft_ticks": {"p50": ttft99 / 2, "p99": ttft99},
+            "tpot_ticks": {"p50": tpot99, "p99": tpot99},
+            "queue_depth_max": qmax}
+
+
+def test_slospec_validation_and_default():
+    with pytest.raises(ValueError, match="ttft_p99_ticks"):
+        SLOSpec(ttft_p99_ticks=0.0)
+    with pytest.raises(ValueError, match="tpot_p99_ticks"):
+        SLOSpec(ttft_p99_ticks=10.0, tpot_p99_ticks=-1.0)
+    prog = types.SimpleNamespace(n_slots=3, n_stages=2, prompt_max=12,
+                                 prefill_chunk=2)
+    spec = SLOSpec.default_for(prog)
+    # service bound: ceil(12/2)*3 + 2 + 3 = 23 visits; budget 4x
+    assert spec.ttft_p99_ticks == 92.0
+    assert spec.tpot_p99_ticks == 6.0
+    assert spec.queue_depth_limit == 12.0
+
+
+def test_find_knee_matrix():
+    spec = SLOSpec(ttft_p99_ticks=50.0, tpot_p99_ticks=5.0,
+                   queue_depth_limit=8)
+    # every point sustains: no knee
+    k = find_knee([_row(0.4, 20), _row(0.8, 40)], spec)
+    assert k == {"detected": False, "knee_load": None, "reason": None,
+                 "max_sustainable_load": None} or k["detected"] is False
+    # mid-ramp TTFT violation: knee there, max sustainable just below
+    k = find_knee([_row(0.4, 20), _row(0.8, 40), _row(1.0, 60),
+                   _row(1.2, 90)], spec)
+    assert k["detected"] and k["knee_load"] == 1.0
+    assert k["reason"] == "ttft_p99"
+    assert k["max_sustainable_load"] == 0.8
+    # first point already violates: nothing sustains
+    k = find_knee([_row(0.4, 60), _row(0.8, 90)], spec)
+    assert k["detected"] and k["knee_load"] == 0.4
+    assert k["max_sustainable_load"] is None
+    # queue divergence vetoes even with latency in budget
+    k = find_knee([_row(0.4, 20), _row(0.8, 30, qmax=9)], spec)
+    assert k["reason"] == "queue_depth" and k["knee_load"] == 0.8
+    # TPOT-only violation is named
+    k = find_knee([_row(0.4, 20), _row(0.8, 30, tpot99=6.0)], spec)
+    assert k["reason"] == "tpot_p99"
+
+
+def test_slo_attainment_counts_failed_requests_against():
+    spec = SLOSpec(ttft_p99_ticks=10.0)
+    mk = lambda ttft, status="ok": types.SimpleNamespace(  # noqa: E731
+        ttft_ticks=ttft, tpot_ticks=None, status=status, tokens=[1, 2])
+    res = types.SimpleNamespace(
+        completions=[mk(5.0), mk(20.0), mk(0.0, status="failed")], ticks=10)
+    att = slo_attainment(res, spec)
+    assert att["n_ok"] == 2 and att["n_met"] == 1
+    assert att["attainment"] == pytest.approx(1 / 3)
+    assert att["goodput_under_slo"] == pytest.approx(0.2)
+
+
+# ---------------------------------------------------------------------------
+# serving_load manifest schema: golden accept + malformed rejects
+# ---------------------------------------------------------------------------
+
+
+def _golden_section():
+    curve = [dict(_row(0.4, 20), ticks=100, tokens_out=50, goodput=0.5),
+             dict(_row(0.8, 40), ticks=120, tokens_out=50, goodput=0.4),
+             dict(_row(1.2, 90), ticks=150, tokens_out=50, goodput=0.3)]
+    spec = SLOSpec(ttft_p99_ticks=50.0)
+    return serving_load_section(curve, find_knee(curve, spec), spec,
+                                mix="mixed", n_requests=24, seed=0)
+
+
+def _manifest_with(section, tmp_path):
+    report = RunReport(out_dir=str(tmp_path), name="sl_test")
+    report.set_meta(backend="cpu")
+    report.attach_serving_load(section)
+    return report.write()
+
+
+def test_serving_load_section_golden_accept(tmp_path):
+    manifest = _manifest_with(_golden_section(), tmp_path)
+    validate_report(manifest)
+    sl = manifest["serving_load"]
+    assert sl["knee"]["detected"] and sl["knee"]["knee_load"] == 1.2
+    assert sl["knee"]["max_sustainable_load"] == 0.8
+    assert sl["offered_loads"] == [0.4, 0.8, 1.2]
+    # reference defaults to the lowest swept load
+    assert sl["reference"]["offered_load"] == 0.4
+    assert sl["reference"]["ttft_p99_ticks"] == 20
+    # round-trips through JSON (the file regress.py will read)
+    path = tmp_path / "report.json"
+    assert path.exists()
+    validate_report(json.loads(path.read_text()))
+
+
+@pytest.mark.parametrize("mutate,msg", [
+    (lambda sl: sl.pop("knee"), "knee"),
+    (lambda sl: sl["knee"].pop("detected"), "knee"),
+    (lambda sl: sl["knee"].update(detected=True, knee_load=None),
+     "knee_load"),
+    (lambda sl: sl["curve"][0]["ttft_ticks"].pop("p99"),
+     "percentile dict carrying p99"),
+    (lambda sl: sl["curve"][0].update(ttft_ticks=[20.0]),
+     "percentile dict carrying p99"),
+    (lambda sl: sl["curve"][1].update(offered_load=0.3),
+     "strictly increasing"),
+    (lambda sl: sl["curve"][0].update(offered_load="low"), "offered_load"),
+    (lambda sl: sl.update(curve=[]), "non-empty"),
+    (lambda sl: sl.pop("workload"), "workload"),
+    (lambda sl: sl["workload"].update(n_requests="many"), "n_requests"),
+    (lambda sl: sl.pop("slo"), "ttft_p99_ticks"),
+    (lambda sl: sl.update(policy=7), "policy"),
+    (lambda sl: sl["curve"][0].update(ticks=1.5), "ticks"),
+    (lambda sl: sl.update(reference={"offered_load": "x"}), "reference"),
+])
+def test_serving_load_section_malformed_rejects(tmp_path, mutate, msg):
+    manifest = _manifest_with(_golden_section(), tmp_path)
+    mutate(manifest["serving_load"])
+    with pytest.raises(ValueError, match=msg):
+        validate_report(manifest)
+
+
+def test_serving_load_section_requires_rows():
+    spec = SLOSpec(ttft_p99_ticks=50.0)
+    with pytest.raises(ValueError, match=">= 1 curve row"):
+        serving_load_section([], {"detected": False}, spec, mix="mixed",
+                             n_requests=0, seed=0)
+
+
+# ---------------------------------------------------------------------------
+# regress.py: extraction + rc matrix for the serving-load guards
+# ---------------------------------------------------------------------------
+
+
+def _sl_manifest(tmp_path, i, max_load, ttft_ref, backend="tpu"):
+    m = {"meta": {"name": "serve_load", "backend": backend,
+                  "schedule": {"name": "serving"}},
+         "serving_load": {
+             "knee": {"detected": True, "knee_load": max_load + 0.4,
+                      "max_sustainable_load": max_load},
+             "reference": {"offered_load": 0.4,
+                           "ttft_p99_ticks": ttft_ref}}}
+    path = tmp_path / f"sl{i}.json"
+    path.write_text(json.dumps(m))
+    return str(path)
+
+
+def test_regress_extracts_serving_load_metrics(tmp_path):
+    regress = _load_script("regress")
+    with open(_sl_manifest(tmp_path, 0, 0.8, 20.0)) as fh:
+        row = regress.extract_metrics(json.load(fh))
+    assert row["max_sustainable_load"] == 0.8
+    assert row["serve_ttft_p99_ref"] == 20.0
+    # the schedule-artifact branch carries the columns too (as None)
+    art = regress.extract_metrics({"kind": "schedule_artifact"})
+    assert art["max_sustainable_load"] is None
+    assert art["serve_ttft_p99_ref"] is None
+
+
+def test_regress_serving_load_rc_matrix(tmp_path):
+    """Knee moved left / reference TTFT inflated => rc 1 off-cpu; the
+    same regression on a cpu-proxy report warns but passes; recovered
+    numbers pass."""
+    regress = _load_script("regress")
+    hist = str(tmp_path / "history.jsonl")
+    base = ["--history", hist]
+    # baseline x2 so the median is established
+    for i in range(2):
+        assert regress.main(["--report",
+                             _sl_manifest(tmp_path, i, 0.8, 20.0)]
+                            + base) == 0
+    # max_sustainable_load down 25% => fail (direction "down")
+    assert regress.main(["--report", _sl_manifest(tmp_path, 2, 0.6, 20.0)]
+                        + base) == 1
+    # reference p99 TTFT up 50% => fail (direction "up")
+    assert regress.main(["--report", _sl_manifest(tmp_path, 3, 0.8, 30.0)]
+                        + base) == 1
+    # cpu proxy: same regression, warn-only by backend rule
+    assert regress.main(["--report",
+                         _sl_manifest(tmp_path, 4, 0.6, 30.0, backend="cpu")]
+                        + base) == 0
+    # within tolerance passes (tpu group median still 0.8/20.0)
+    assert regress.main(["--report", _sl_manifest(tmp_path, 5, 0.78, 21.0)]
+                        + base) == 0
+
+
+# ---------------------------------------------------------------------------
+# Perfetto serving-load tracks (pure event-shaping, no jax)
+# ---------------------------------------------------------------------------
+
+
+def test_perfetto_serving_load_events_shapes():
+    events = [
+        {"kind": "serve_admit", "rid": 0, "slot": 1, "tick": 5,
+         "arrival": 2.0, "prompt_len": 3, "budget": 4},
+        {"kind": "serve_admit", "rid": 1, "slot": 0, "tick": 7,
+         "arrival": 7.0},  # zero wait: no wait slice
+        {"kind": "serve_finish", "rid": 0, "slot": 1, "tick": 20,
+         "n_tokens": 4, "ttft_ticks": 5.0},
+    ]
+    rows = perfetto_serving_load_events(
+        events, occupancy=[(0, 0), (5, 2)], queue_depth=[(5, 1)],
+        s_per_tick=None)
+    waits = [r for r in rows if r.get("cat") == "queue_wait"]
+    serves = [r for r in rows if r.get("cat") == "execution"]
+    counters = [r for r in rows if r["ph"] == "C"]
+    assert len(waits) == 1 and waits[0]["ts"] == 2.0
+    assert waits[0]["dur"] == 3.0 and waits[0]["args"]["rid"] == 0
+    assert len(serves) == 2
+    s0 = next(r for r in serves if r["args"]["rid"] == 0)
+    assert s0["ts"] == 5.0 and s0["dur"] == 15.0
+    assert s0["args"]["n_tokens"] == 4
+    assert len(counters) == 3
+    assert all(r["pid"] == 3 for r in waits + serves + counters)
+    # s_per_tick scales the clock (1 tick -> 2 us)
+    scaled = perfetto_serving_load_events(events, s_per_tick=2e-6)
+    s0 = next(r for r in scaled if r.get("cat") == "execution"
+              and r["args"]["rid"] == 0)
+    assert s0["ts"] == 10.0 and s0["dur"] == 30.0
+    assert perfetto_serving_load_events([]) == []
+
+
+# ---------------------------------------------------------------------------
+# One real sweep through a compiled engine
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_offered_load_end_to_end(tmp_path):
+    """A tiny 2-point ramp through one compiled engine: validated
+    section, one compile across the whole ramp, monotone p99 TTFT, and
+    the load-independent roofline column on every row."""
+    cfg = dtpp.ModelConfig(dim=32, n_layers=4, n_heads=4, vocab_size=64,
+                           ffn_dim=64, max_seq_len=64, arch="gpt2")
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    program = make_serving_step_fn(cfg, make_mesh(n_pipe=2), n_slots=2,
+                                   max_len=32, prompt_max=12, out_max=16,
+                                   prefill_chunk=2, eos_id=None)
+    report = RunReport(out_dir=str(tmp_path), name="sweep_test")
+    report.set_meta(backend=jax.devices()[0].platform)
+    engine = ServingEngine(program, params, report=report)
+    with pytest.raises(ValueError, match="strictly increasing"):
+        sweep_offered_load(engine, [0.8, 0.4], n_requests=4)
+    with pytest.raises(ValueError, match=">= 2 offered loads"):
+        sweep_offered_load(engine, [0.8], n_requests=4)
+    section = sweep_offered_load(engine, [0.5, 1.2], mix="short_chat",
+                                 n_requests=6, seed=2)
+    assert program.step._cache_size() == 1  # one compile, sweep-wide
+    report.attach_serving_load(section)
+    validate_report(report.write())
+    rows = section["curve"]
+    assert [r["offered_load"] for r in rows] == [0.5, 1.2]
+    p99 = [r["ttft_ticks"]["p99"] for r in rows]
+    assert p99[0] <= p99[1]  # same-seed ramp: monotone by construction
+    for r in rows:
+        assert r["predicted_s_per_tick"] > 0
+        assert r["slo"]["attainment"] is not None
+        assert r["busy_ticks"] <= r["ticks"]
