@@ -184,7 +184,7 @@ def _time_step(step, params, tokens, targets, num_iterations):
 def run_config(cfg, batch_size, seq_length, num_iterations=20,
                schedule="GPipe", n_microbatches=4, n_virtual=1,
                force_tick_executor=False, remat_backward=None,
-               unroll_ticks=None, n_pipe=None) -> dict:
+               unroll_ticks=None, n_pipe=None, comm_overlap=None) -> dict:
     if n_pipe is None:  # 1-D pipeline mesh over every visible chip
         n_pipe = len(jax.devices())
     sched = dtpp.ScheduleConfig(name=schedule, n_microbatches=n_microbatches,
@@ -193,7 +193,8 @@ def run_config(cfg, batch_size, seq_length, num_iterations=20,
     step = make_pipeline_step(cfg, mesh, sched,
                               force_tick_executor=force_tick_executor,
                               remat_backward=remat_backward,
-                              unroll_ticks=unroll_ticks)
+                              unroll_ticks=unroll_ticks,
+                              comm_overlap=comm_overlap or "none")
     params = tfm.transformer_init(jax.random.key(0), cfg)
     tokens = jax.random.randint(jax.random.key(1), (batch_size, seq_length),
                                 0, cfg.vocab_size)
@@ -208,7 +209,8 @@ def run_config(cfg, batch_size, seq_length, num_iterations=20,
     row = {"tokens_per_sec": round(throughput, 2),
            "mfu": round(mfu, 4),
            "elapsed_s": round(elapsed, 3),
-           "compile_s": round(compile_s, 2)}
+           "compile_s": round(compile_s, 2),
+           "overlap": comm_overlap or "none"}
     if not math.isfinite(last_loss):
         # a benchmark number for a program computing NaNs is meaningless —
         # flag it loudly in the row rather than failing the whole sweep
@@ -272,6 +274,15 @@ def _result(headline, extra, n_pipe) -> dict:
                         "backend_error", "chip_peak_flops") if k in extra})
     for k, v in headline.items():
         report.gauge(f"headline_{k}", v)
+    # the overlap pair gets first-class gauges so scripts/regress.py can
+    # guard overlap-on throughput per (name, backend, schedule) group
+    for ov_key in ("overlap_on", "overlap_off"):
+        ov_row = extra.get(ov_key)
+        if isinstance(ov_row, dict) and "tokens_per_sec" in ov_row:
+            report.gauge(f"{ov_key}_tokens_per_sec",
+                         ov_row["tokens_per_sec"])
+    if isinstance(extra.get("overlap_speedup"), (int, float)):
+        report.gauge("overlap_speedup", extra["overlap_speedup"])
     cm = extra.get("cost_model")
     if isinstance(cm, dict) and "schedule" in cm:  # not an error stub
         report.attach_cost_model(cm)
@@ -399,6 +410,27 @@ def run(num_iterations: int = 20) -> dict:
             remat_backward=True, unroll_ticks=False, n_pipe=n_pipe)
     except Exception as e:  # pragma: no cover - hardware-dependent
         extra["tick_executor_scan"] = {"error": str(e)}
+    # comm/compute overlap pair (docs/performance.md "Comm/compute
+    # overlap"): the SAME unrolled stored program with each tick's ring
+    # hops issued at their deferred bank points (comm_overlap="ring",
+    # bit-identical by the table_check overlap discipline) vs the
+    # lockstep baseline. On a real multi-chip mesh overlap_speedup >= 1
+    # is the bar scripts/regress.py guards; a 1-chip or cpu host
+    # serializes every tick, so there the pair proves the staged program
+    # dispatches and stays parity, not that it is faster.
+    try:
+        off = run_config(ref_cfg, 32, 128, num_iterations,
+                         force_tick_executor=True, unroll_ticks=True,
+                         n_pipe=n_pipe, comm_overlap="none")
+        on = run_config(ref_cfg, 32, 128, num_iterations,
+                        force_tick_executor=True, unroll_ticks=True,
+                        n_pipe=n_pipe, comm_overlap="ring")
+        extra["overlap_off"] = off
+        extra["overlap_on"] = on
+        extra["overlap_speedup"] = round(
+            on["tokens_per_sec"] / off["tokens_per_sec"], 3)
+    except Exception as e:  # pragma: no cover - hardware-dependent
+        extra["overlap_on"] = {"error": str(e)}
     # tie_embeddings=True is the real GPT-2 124M (and keeps the MFU's 6*N
     # honest: the tied table is the head matmul); unroll_layers +
     # batch 16/8 are the measured round-3 MFU levers (docs/performance.md)

@@ -6,7 +6,10 @@ lint; ``--jaxpr`` traces small train/serving step functions on a
 simulated mesh and audits them (needs a jax backend — the script wrapper
 sets up 8 fake CPU devices before any jax import); ``--memory`` prices
 per-device HBM over the same grid and pins the analytic-bytes identity
-(docs/observability.md "Memory observatory"); ``--all`` is every pass.
+(docs/observability.md "Memory observatory"); ``--overlap`` prices the
+grid in the cost model's ``comm_overlap`` mode and pins the overlap
+sandwich + two-buffer hop census (docs/performance.md "Comm/compute
+overlap"); ``--all`` is every pass.
 Exit code 0 iff every requested pass is clean. ``--json PATH`` writes
 the full structured report (the CI artifact).
 """
@@ -169,6 +172,78 @@ def run_memory_checks(grid: Optional[List[GridEntry]] = None
             "batch_size": batch, "seq_length": seq, "reports": reports}
 
 
+def run_overlap_checks(grid: Optional[List[GridEntry]] = None
+                       ) -> Dict[str, Any]:
+    """The ``--overlap`` pass: over the full schedule grid, price every
+    table in the cost model's ``comm_overlap`` mode and pin the overlap
+    contract (pure numpy — no jax backend):
+
+    - ``step_s_comm_overlap <= step_s`` for every entry (hiding hops can
+      never slow the predicted step down);
+    - ``step_s_overlapped <= step_s_comm_overlap`` summed over the grid's
+      real tables (the optimistic launch-tick bound stays below the
+      bank-tick priced mode — the two attributions can differ tick by
+      tick, so this is pinned per entry here where it holds for every
+      registered schedule);
+    - the verifier's exposed + overlappable hop census equals
+      ``predicted_ppermutes`` (every hop is classified exactly once);
+    - the overlap discipline itself is hazard-free (``check_table``'s
+      two-buffer extension).
+    """
+    from ..parallel.schedules import ScheduleError, compile_schedule
+    from .cost_model import comm_overlap_step_time, predicted_step_time
+    from .table_check import check_table
+
+    unit_s, hop_s = (1.0, 2.0, 1.0), 0.25
+    reports: List[Dict[str, Any]] = []
+    n_bad = 0
+    for name, D, V, M in (grid if grid is not None else default_grid()):
+        row: Dict[str, Any] = {"name": name, "n_devices": D, "n_virtual": V,
+                               "n_microbatches": M}
+        try:
+            cs = compile_schedule(name, D, V, M)
+        except ScheduleError as e:
+            row.update(ok=False, error=f"compile failed: {e}")
+            reports.append(row)
+            n_bad += 1
+            continue
+        tr = check_table(cs)
+        base = predicted_step_time(cs.table, unit_s, hop_s,
+                                   tr.predicted_ppermutes)
+        ov = comm_overlap_step_time(cs.table, unit_s, hop_s)
+        census = sum(v["exposed_hop_ticks"] + v["overlappable_hop_ticks"]
+                     for k, v in tr.overlap.items()
+                     if k in tr.comm and tr.comm[k]["hop_ticks"] > 0)
+        problems: List[str] = []
+        if ov["step_s_comm_overlap"] > base["step_s"] + 1e-9:
+            problems.append(
+                f"comm_overlap {ov['step_s_comm_overlap']:.3f} > lockstep "
+                f"step_s {base['step_s']:.3f}")
+        if base["step_s_overlapped"] > ov["step_s_comm_overlap"] + 1e-9:
+            problems.append(
+                f"optimistic bound {base['step_s_overlapped']:.3f} > "
+                f"comm_overlap {ov['step_s_comm_overlap']:.3f}")
+        if census != tr.predicted_ppermutes:
+            problems.append(f"overlap census {census} != predicted "
+                            f"ppermutes {tr.predicted_ppermutes}")
+        stage_hazards = [str(h) for h in tr.hazards
+                         if h.kind.startswith("overlap-")]
+        if stage_hazards:
+            problems.extend(stage_hazards)
+        row.update(ok=not problems,
+                   step_s=base["step_s"],
+                   step_s_overlapped=base["step_s_overlapped"],
+                   step_s_comm_overlap=ov["step_s_comm_overlap"],
+                   exposed_hops=ov["exposed_hops"],
+                   overlappable_hops=ov["overlappable_hops"],
+                   problems=problems)
+        if problems:
+            n_bad += 1
+        reports.append(row)
+    return {"n_checked": len(reports), "n_bad": n_bad, "ok": n_bad == 0,
+            "unit_s": list(unit_s), "hop_s": hop_s, "reports": reports}
+
+
 def run_lint() -> Dict[str, Any]:
     from .repo_lint import findings_summary, lint_repo
     findings = lint_repo()
@@ -203,16 +278,58 @@ def run_jaxpr_audits() -> Dict[str, Any]:
     for name, V, M in (("GPipe", 1, 4), ("1F1B", 1, 4),
                        ("Interleaved1F1B", 2, 4)):
         sched = ScheduleConfig(name=name, n_microbatches=M, n_virtual=V)
-        step = make_pipeline_step(cfg, mesh, sched, unroll_ticks=True)
         predicted = check_table(_compile(name, 4, V, M)).predicted_ppermutes
-        audit = audit_fn(step, params, tokens, targets,
-                         mesh_axes=tuple(mesh.axis_names),
-                         expect_no_callbacks=True,
-                         expected_ppermutes=predicted)
-        case = {"case": f"train/{name}[D=4,V={V},M={M}]",
-                "predicted_ppermutes": predicted, **audit.summary()}
-        out["cases"].append(case)
-        out["ok"] = out["ok"] and audit.ok
+        # lockstep AND double-buffered executors: deferred banking moves
+        # the store commit point, never the hop — both trace the table's
+        # predicted comm volume exactly
+        for comm_overlap in ("none", "ring"):
+            step = make_pipeline_step(cfg, mesh, sched, unroll_ticks=True,
+                                      comm_overlap=comm_overlap)
+            audit = audit_fn(step, params, tokens, targets,
+                             mesh_axes=tuple(mesh.axis_names),
+                             expect_no_callbacks=True,
+                             expected_ppermutes=predicted)
+            case = {"case": f"train/{name}[D=4,V={V},M={M},"
+                            f"overlap={comm_overlap}]",
+                    "predicted_ppermutes": predicted, **audit.summary()}
+            out["cases"].append(case)
+            out["ok"] = out["ok"] and audit.ok
+    # collective-matmul census: the ring TP forward traces exactly
+    # (T-1) ppermutes per ring gather/scatter (no bare all_gather)
+    import dataclasses as _dc
+
+    import numpy as np
+    from jax.sharding import Mesh as _Mesh, PartitionSpec as _P
+
+    from ..models.transformer import layer_init, mlp_block
+    from .jaxpr_audit import collective_matmul_ppermutes
+    try:
+        from jax.shard_map import shard_map as _shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    T = 4
+    tp_mesh = _Mesh(np.array(jax.devices()[:T]), ("model",))
+    tp_cfg = _dc.replace(cfg, arch="gpt2", tp_overlap="ring")
+    lp = layer_init(jax.random.key(1), tp_cfg)
+    mlp_specs = {"lin1": {"w": _P(None, "model"), "b": _P("model")},
+                 "lin2": {"w": _P("model", None), "b": _P(None)}}
+    specs = {k: mlp_specs.get(k, jax.tree.map(lambda _: _P(), lp[k]))
+             for k in lp}
+    ring_fwd = _shard_map(
+        lambda p, x: mlp_block(tp_cfg, p, x, tp_axis="model", tp_size=T),
+        mesh=tp_mesh, in_specs=(specs, _P()), out_specs=_P(),
+        check_rep=False)
+    # gpt2 ring MLP: all_gather_matmul + matmul_reduce_scatter +
+    # seq_all_gather = 3 ring collectives
+    expected_tp = collective_matmul_ppermutes(T, n_gathers=2, n_scatters=1)
+    audit = audit_fn(ring_fwd, lp,
+                     jnp.zeros((2, 8, tp_cfg.dim), jnp.float32),
+                     mesh_axes=("model",), expect_no_callbacks=True,
+                     expected_ppermutes=expected_tp)
+    out["cases"].append({"case": f"tp_ring_mlp[T={T},gpt2]",
+                         "predicted_ppermutes": expected_tp,
+                         **audit.summary()})
+    out["ok"] = out["ok"] and audit.ok
     # serving block: telemetry-free by construction; audit callbacks + axes
     from ..serving.engine import make_serving_step_fn
     serve_cfg = ModelConfig(dim=16, n_layers=8, n_heads=2, vocab_size=32,
@@ -279,7 +396,7 @@ def run_search(out_path: Optional[str] = None, *, seed: int = 0,
 def run_checks(tables: bool = True, lint: bool = True,
                jaxpr: bool = False, search: bool = False,
                search_out: Optional[str] = None,
-               memory: bool = False) -> Dict[str, Any]:
+               memory: bool = False, overlap: bool = False) -> Dict[str, Any]:
     report: Dict[str, Any] = {"verifier_version": VERIFIER_VERSION}
     ok = True
     if tables:
@@ -288,6 +405,9 @@ def run_checks(tables: bool = True, lint: bool = True,
     if memory:
         report["memory"] = run_memory_checks()
         ok = ok and report["memory"]["ok"]
+    if overlap:
+        report["overlap"] = run_overlap_checks()
+        ok = ok and report["overlap"]["ok"]
     if lint:
         report["lint"] = run_lint()
         ok = ok and report["lint"]["ok"]
@@ -325,6 +445,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="price per-device HBM over the schedule grid and "
                          "pin analytic bytes == slot live peaks x slot "
                          "bytes (host-side, no backend)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="price the schedule grid in comm_overlap mode and "
+                         "pin step_s_overlapped <= step_s_comm_overlap <= "
+                         "step_s plus the two-buffer hop census (host-side, "
+                         "no backend)")
     ap.add_argument("--all", action="store_true", help="all three passes")
     ap.add_argument("--json", metavar="PATH",
                     help="write the structured report to PATH")
@@ -337,12 +462,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     jaxpr = args.jaxpr or args.all
     search = args.search or args.all
     memory = args.memory or args.all
-    if not (tables or lint or jaxpr or search or memory):
+    overlap = args.overlap or args.all
+    if not (tables or lint or jaxpr or search or memory or overlap):
         tables = lint = True  # cheap default: no jax import needed
 
     report = run_checks(tables=tables, lint=lint, jaxpr=jaxpr,
                         search=search, search_out=args.search_out,
-                        memory=memory)
+                        memory=memory, overlap=overlap)
 
     if not args.quiet:
         if "tables" in report:
@@ -371,6 +497,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                       f"V={r['n_virtual']},M={r['n_microbatches']}] "
                       f"{r['backward_policy']}: "
                       f"peak {r['peak_bytes'] / 1e6:.3f} MB  {cells}")
+        if "overlap" in report:
+            ov = report["overlap"]
+            print(f"overlap: {ov['n_checked']} priced, {ov['n_bad']} "
+                  f"contract violations")
+            for r in ov["reports"]:
+                for p in r.get("problems", []) or (
+                        [r["error"]] if "error" in r else []):
+                    print(f"  {r['name']}[D={r['n_devices']},"
+                          f"V={r['n_virtual']},M={r['n_microbatches']}]: "
+                          f"{p}")
         if "lint" in report:
             li = report["lint"]
             print(f"lint: {li['n_findings']} findings")

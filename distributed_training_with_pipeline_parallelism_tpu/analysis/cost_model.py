@@ -41,16 +41,17 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from ..parallel.schedules import (COL_STORE_B_POS_SLOT, COL_STORE_B_SLOT,
+from ..parallel.schedules import (BANK_BEFORE_F, COL_STORE_B_POS_SLOT,
+                                  COL_STORE_B_SLOT,
                                   COL_STORE_F_NEG_SLOT, COL_STORE_F_SLOT,
                                   CompiledSchedule, analytic_bubble_fraction,
-                                  table_unit_activity)
+                                  overlap_bank_stages, table_unit_activity)
 
 __all__ = [
     "HardwareSpec", "CPU_PROXY", "TPU_PRESETS", "hardware_spec_for",
     "detect_hardware", "fwd_flops_per_token", "train_flops_per_token",
     "resolve_backward_policy", "backward_weights", "dtype_bytes",
-    "predicted_step_time", "cost_model_section",
+    "predicted_step_time", "comm_overlap_step_time", "cost_model_section",
     "serving_cost_model_section",
 ]
 
@@ -254,13 +255,71 @@ def predicted_step_time(table: np.ndarray, unit_s: Tuple[float, float, float],
     }
 
 
+def comm_overlap_step_time(table: np.ndarray,
+                           unit_s: Tuple[float, float, float],
+                           hop_s: float,
+                           bank_stages: Optional[np.ndarray] = None,
+                           ) -> Dict[str, float]:
+    """Predicted step time under the DOUBLE-BUFFERED executor
+    (``comm_overlap="ring"``) — the first-class mode between the lockstep
+    ``step_s`` (hops serialized after compute) and the fully optimistic
+    ``step_s_overlapped`` lower bound.
+
+    Attribution follows the executor's actual dataflow: a hop launched at
+    the end of tick ``u-1`` lands in a recv register and is committed at
+    tick ``u``'s bank stage (:func:`..parallel.schedules.
+    overlap_bank_stages`, the same classifier the executor banks by). A
+    stage-0 bank means the first unit of tick ``u`` consumes the arrival —
+    the hop is EXPOSED, serialized exactly as in lockstep. A later stage
+    means the hop overlaps tick ``u``'s earlier compute, so the tick costs
+    ``max(compute_u, overlappable_comm_u)`` instead of the sum:
+
+        time_u = exposed_hops_u * hop_s
+                 + max(compute_u, overlappable_hops_u * hop_s)
+
+    Per tick this is >= ``max(compute_u, all_hops_u * hop_s)`` and
+    <= ``compute_u + all_hops_u * hop_s``, so summed it sits within the
+    [overlapped, serial] envelope the existing bounds quote (the
+    ``overlapped`` bound attributes hops to the LAUNCH tick, so the
+    orderings can differ tick-by-tick, but hold summed on real schedule
+    tables — ``scripts/check.py --overlap`` asserts the grid-wide
+    ``<= step_s`` invariant and the search smoke pins the strict
+    sandwich on searched artifacts)."""
+    table = np.asarray(table)
+    if bank_stages is None:
+        bank_stages = overlap_bank_stages(table)
+    activity = table_unit_activity(table)
+    vec = np.array([unit_s[0], unit_s[1], unit_s[2], 0.0], dtype=np.float64)
+    compute_tick_s = (activity.astype(np.float64) @ vec).max(axis=1)  # [T]
+    T = table.shape[0]
+    exposed = np.zeros(T, dtype=np.int64)
+    deferred = np.zeros(T, dtype=np.int64)
+    for u in range(1, T):
+        for ci, (_, col, _) in enumerate(_STORE_CHANNELS):
+            if (table[u, :, col] >= 0).any():
+                if bank_stages[u, ci] == BANK_BEFORE_F:
+                    exposed[u] += 1
+                else:
+                    deferred[u] += 1
+    tick_s = exposed * hop_s + np.maximum(compute_tick_s, deferred * hop_s)
+    return {
+        "step_s_comm_overlap": float(tick_s.sum()),
+        "exposed_hops": int(exposed.sum()),
+        "overlappable_hops": int(deferred.sum()),
+        "exposed_comm_s": float(exposed.sum() * hop_s),
+        "hidden_comm_s": float(
+            (np.minimum(deferred * hop_s, compute_tick_s)).sum()),
+    }
+
+
 def cost_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
                        seq_length: int,
                        hardware: Optional[HardwareSpec] = None,
                        remat_backward=None,
                        measured_step_s: Optional[float] = None,
                        telemetry=None,
-                       table_report=None) -> Dict[str, Any]:
+                       table_report=None,
+                       comm_overlap: str = "none") -> Dict[str, Any]:
     """Price one compiled schedule against a roofline; reconcile with a
     measured run when one is supplied.
 
@@ -269,8 +328,11 @@ def cost_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
     given explicitly, and adds the critical-path attribution table
     (compute vs comm vs bubble seconds, straggler stage).
     ``table_report``: a precomputed :class:`.table_check.TableReport`;
-    verified fresh via ``check_table`` when absent. Returns the plain
-    dict that ``RunReport.attach_cost_model`` embeds."""
+    verified fresh via ``check_table`` when absent. ``comm_overlap``
+    records the ring-hop discipline the run's executor compiled
+    ("none"/"ring") — the ``step_s_comm_overlap`` prediction itself is
+    always reported (it prices the table, not the run). Returns the
+    plain dict that ``RunReport.attach_cost_model`` embeds."""
     table = cs.table
     T, D = int(table.shape[0]), int(table.shape[1])
     hw = hardware if hardware is not None else detect_hardware()
@@ -304,9 +366,10 @@ def cost_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
     # --- roofline: lockstep per-tick max across devices, hops serialized
     # or overlapped — the shared time model (predicted_step_time) the
     # schedule search optimizes, so search objective == reported cost
-    tm = predicted_step_time(
-        table, (unit_f / hw.peak_flops, unit_b / hw.peak_flops,
-                unit_w / hw.peak_flops), hop_s, hops_total)
+    unit_sec = (unit_f / hw.peak_flops, unit_b / hw.peak_flops,
+                unit_w / hw.peak_flops)
+    tm = predicted_step_time(table, unit_sec, hop_s, hops_total)
+    ov = comm_overlap_step_time(table, unit_sec, hop_s)
     t_compute_s = tm["compute_s"]
     t_comm_s = tm["comm_s"]
     ideal_compute_s = hardware_per_step / (D * hw.peak_flops)
@@ -328,6 +391,7 @@ def cost_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
         "batch_size": int(batch_size),
         "seq_length": int(seq_length),
         "backward_policy": policy,
+        "comm_overlap": comm_overlap,
         "hardware": hw.summary(),
         "flops": {
             "fwd_per_token": fwd_tok,
@@ -340,12 +404,17 @@ def cost_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
             "bytes_per_hop": float(bytes_per_hop),
             "hops": hops_total,
             "bytes_total": float(bytes_per_hop) * hops_total,
+            "exposed_hops": ov["exposed_hops"],
+            "overlappable_hops": ov["overlappable_hops"],
         },
         "predicted": {
             "compute_s": t_compute_s,
             "comm_s": t_comm_s,
             "step_s": t_compute_s + t_comm_s,
             "step_s_overlapped": step_s_overlapped,
+            "step_s_comm_overlap": ov["step_s_comm_overlap"],
+            "exposed_comm_s": ov["exposed_comm_s"],
+            "hidden_comm_s": ov["hidden_comm_s"],
             "ideal_compute_s": ideal_compute_s,
             "bubble_table_exact": bubble_table_exact,
             "bubble_weighted": bubble_weighted,
@@ -431,6 +500,9 @@ def serving_cost_model_section(cfg, n_pipe: int, n_slots: int,
             "comm_s": hop_s,
             "step_s": per_tick_compute_s + hop_s,   # per tick
             "step_s_overlapped": max(per_tick_compute_s, hop_s),
+            # the serving ring is still lockstep (arrival consumed at the
+            # tick top), so its comm_overlap prediction equals serial
+            "step_s_comm_overlap": per_tick_compute_s + hop_s,
             "ideal_compute_s": per_tick_compute_s,
             "bubble_table_exact": 0.0,
             "bubble_weighted": 0.0,

@@ -230,3 +230,16 @@ def audit_fn(fn: Any, *args: Any, mesh_axes: Sequence[str] = (),
 
     closed = jax.make_jaxpr(fn)(*args)
     return audit_jaxpr(closed, mesh_axes=mesh_axes, **kwargs)
+
+
+def collective_matmul_ppermutes(axis_size: int, n_gathers: int,
+                                n_scatters: int = 0) -> int:
+    """Chunked-permute census for the ring collective-matmul forms
+    (:mod:`..ops.collectives`): every ring gather (``all_gather_matmul``,
+    ``seq_all_gather``) and ring scatter (``matmul_reduce_scatter``)
+    traces exactly ``axis_size - 1`` ppermutes. Add this to a program's
+    ``expected_ppermutes`` when auditing a ``tp_overlap="ring"`` forward
+    — the double-buffered pipeline executors themselves keep the table's
+    ``predicted_ppermutes`` unchanged (deferred banking moves the store
+    commit, never the hop)."""
+    return (int(axis_size) - 1) * (int(n_gathers) + int(n_scatters))

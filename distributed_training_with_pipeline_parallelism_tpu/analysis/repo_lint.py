@@ -1,6 +1,6 @@
 """AST-based repo lint: project rules the test suite cannot see.
 
-Three rules, each encoding a contract documented elsewhere in the repo
+Each rule encodes a contract documented elsewhere in the repo
 (docs/static_analysis.md explains how to add more):
 
 ``scan-body-host-call``
@@ -37,6 +37,15 @@ Three rules, each encoding a contract documented elsewhere in the repo
     ``compile_schedule``/``compile_order`` or a certified schedule
     artifact, which is what makes the static certification meaningful
     (docs/static_analysis.md "Schedule compiler").
+
+``tp-bare-collective``
+    No bare ``jax.lax.all_gather`` / ``jax.lax.psum_scatter`` *calls* in
+    ``parallel/tensor_parallel.py`` outside the collective-matmul
+    wrappers (``tp_all_gather_matmul`` / ``tp_matmul_reduce_scatter``).
+    The wrappers are the single dispatch point for the ``tp_overlap``
+    knob (docs/performance.md "Comm/compute overlap") — a bare call
+    elsewhere silently bypasses the ring overlap path. Reads/mentions
+    of the names stay legal; only call sites are flagged.
 
 ``dynamics-sync-read``
     No host fetch (``jax.device_get``, ``jax.block_until_ready``, or a
@@ -261,6 +270,37 @@ def _lint_raw_tables(tree: ast.AST, path: str,
                         "compile_order or a certified artifact"))
 
 
+# tp-bare-collective: the only functions in parallel/tensor_parallel.py
+# allowed to call the bare lax collectives they wrap.
+_TP_WRAPPER_FNS = frozenset({"tp_all_gather_matmul",
+                             "tp_matmul_reduce_scatter"})
+_TP_BARE_COLLECTIVES = frozenset({"all_gather", "psum_scatter"})
+
+
+def _lint_tp_bare_collectives(tree: ast.AST, path: str,
+                              findings: List[LintFinding]) -> None:
+    def walk(node: ast.AST, inside_wrapper: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inside_wrapper = inside_wrapper or node.name in _TP_WRAPPER_FNS
+        if isinstance(node, ast.Call) and not inside_wrapper:
+            dotted = _dotted_name(node.func)
+            if dotted is not None:
+                parts = dotted.split(".")
+                if (parts[-1] in _TP_BARE_COLLECTIVES
+                        and "lax" in parts[:-1]):
+                    findings.append(LintFinding(
+                        path, node.lineno, "tp-bare-collective",
+                        f"{dotted}(): bare collective in parallel/"
+                        f"tensor_parallel.py outside the collective-"
+                        f"matmul wrappers — route through "
+                        f"tp_all_gather_matmul/tp_matmul_reduce_scatter "
+                        f"so the tp_overlap knob stays authoritative"))
+        for child in ast.iter_child_nodes(node):
+            walk(child, inside_wrapper)
+
+    walk(tree, False)
+
+
 # dynamics-sync-read: modules that own the log-sync boundary (train's
 # fit loop, the dynamics host helpers) or read off the timed clock
 # (sweep's post-loop probe).
@@ -334,6 +374,8 @@ def lint_source(path: str, source: str,
         _lint_raw_tables(tree, path, findings)
     if parts[0] != "analysis" and rel_posix not in _DYN_SYNC_ALLOWLIST:
         _lint_dynamics_sync_reads(tree, path, findings)
+    if rel_posix == "parallel/tensor_parallel.py":
+        _lint_tp_bare_collectives(tree, path, findings)
     return findings
 
 

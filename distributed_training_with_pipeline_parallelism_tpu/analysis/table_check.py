@@ -25,6 +25,11 @@ table proves:
 - ``compress_schedule`` -> ``replay_phases`` bit-exact roundtrip and
   ``table_unit_activity`` unit counts against the action set
   ``validate_order`` demands for (D, V, M, split_backward).
+- the two-buffer ring discipline: ``overlap_bank_stages``'s deferred
+  bank points re-verified independently (no unit ordered before a bank
+  reads or writes the banked slot; same-slot channels keep lockstep
+  write order), with per-channel exposed vs overlappable hop counts —
+  the static proof behind ``comm_overlap="ring"``'s bit parity.
 
 Everything here is numpy over the table plus the compiled metadata — no
 jax import, so the checks run at table-build time (``DTPP_VERIFY_TABLES``)
@@ -39,15 +44,17 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..parallel.schedules import (COL_BWD_ASLOT, COL_BWD_GSLOT,
+from ..parallel.schedules import (BANK_BEFORE_B, BANK_BEFORE_F, BANK_BEFORE_W,
+                                  COL_BWD_ASLOT, COL_BWD_GSLOT,
                                   COL_BWD_LOCAL_SLOT, COL_BWD_M, COL_BWD_V,
                                   COL_FWD_LOCAL_SLOT, COL_FWD_M, COL_FWD_SLOT,
                                   COL_FWD_V, COL_STORE_B_POS_SLOT,
                                   COL_STORE_B_SLOT, COL_STORE_F_NEG_SLOT,
                                   COL_STORE_F_SLOT, COL_W_ASLOT, COL_W_GSLOT,
-                                  COL_W_M, COL_W_V, N_COLS, CompiledSchedule,
-                                  ScheduleError, bwd_route, compress_schedule,
-                                  fwd_route, phase_spans,
+                                  COL_W_M, COL_W_V, N_COLS, OVERLAP_CHANNELS,
+                                  CompiledSchedule, ScheduleError, bwd_route,
+                                  compress_schedule, fwd_route,
+                                  overlap_bank_stages, phase_spans,
                                   placement_stage_of, replay_phases,
                                   table_unit_activity)
 
@@ -130,6 +137,11 @@ class TableReport:
     comm: Dict[str, Dict[str, int]]
     unit_counts: Dict[str, int]
     compression: Dict[str, int]
+    # channel key -> {"exposed_hop_ticks", "overlappable_hop_ticks"}: the
+    # verified two-buffer discipline (train tables only; {} otherwise).
+    # exposed + overlappable == the channel's hop_ticks.
+    overlap: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -177,6 +189,9 @@ class TableReport:
             "predicted_ppermutes": self.predicted_ppermutes,
             "unit_counts": dict(self.unit_counts),
             "compression": dict(self.compression),
+            "overlap": {k: dict(v) for k, v in self.overlap.items()},
+            "overlappable_hop_ticks": sum(
+                v["overlappable_hop_ticks"] for v in self.overlap.values()),
         }
 
 
@@ -198,6 +213,100 @@ def _comm_volume(table: np.ndarray) -> Dict[str, Dict[str, int]]:
                      ("bwd_local", COL_BWD_LOCAL_SLOT)):
         out[key] = {"cells": int((table[:, :, col] >= 0).sum()),
                     "hop_ticks": 0}
+    return out
+
+
+# Per-unit slot touches the deferred-bank discipline must not reorder
+# against: (unit label, bank stage the unit runs after, activity column,
+# ((slot column, buffer kind), ...)). A bank deferred past the unit's
+# stage while the unit reads OR writes the banked slot breaks lockstep
+# equivalence (reads would see the new arrival early; writes must land
+# after the bank so the unit's write stays last).
+_OVERLAP_UNIT_TOUCHES: Tuple[Tuple[str, int, int, Tuple[Tuple[int, str], ...]],
+                             ...] = (
+    ("F", BANK_BEFORE_F, COL_FWD_M,
+     ((COL_FWD_SLOT, "act"), (COL_FWD_LOCAL_SLOT, "act"))),
+    ("B", BANK_BEFORE_B, COL_BWD_M,
+     ((COL_BWD_ASLOT, "act"), (COL_BWD_GSLOT, "grad"),
+      (COL_BWD_LOCAL_SLOT, "grad"))),
+    ("W", BANK_BEFORE_W, COL_W_M,
+     ((COL_W_ASLOT, "act"), (COL_W_GSLOT, "grad"))),
+)
+
+# OVERLAP_CHANNELS shares RING_CHANNELS' column order; map columns to the
+# report's channel keys so overlap stats join the comm dict keyspace.
+_OVERLAP_KEYS: Tuple[str, ...] = tuple(key for key, _, _ in RING_CHANNELS)
+
+
+def _overlap_discipline(table: np.ndarray,
+                        hazards: List[Hazard]) -> Dict[str, Dict[str, int]]:
+    """Verify the two-buffer (deferred-bank) ring discipline and count
+    exposed vs overlappable hops per channel.
+
+    ``schedules.overlap_bank_stages`` is the executor's single source of
+    truth for where each arrival is committed; this check re-derives the
+    constraint set independently (unit by unit, device by device) and
+    flags any tick where a claimed bank stage is deferred past a unit that
+    reads or writes the banked slot (``overlap-stage``), or where two
+    same-buffer channels landing in one slot are assigned different
+    stages, losing the lockstep write order (``overlap-order``). A clean
+    report therefore *proves* the staged executor bit-equivalent to the
+    lockstep one on this table.
+
+    Returns per-channel ``{"exposed_hop_ticks", "overlappable_hop_ticks"}``
+    over ticks ``t >= 1`` (same attribution as ``hop_ticks``): a hop whose
+    arrival banks at stage 0 fences the next tick's first unit (exposed);
+    any later stage lets the hop overlap the units before its bank point.
+    """
+    st = overlap_bank_stages(table)
+    T = table.shape[0]
+    out: Dict[str, Dict[str, int]] = {}
+    for ci, (bank_col, kind) in enumerate(OVERLAP_CHANNELS):
+        slots = table[:, :, bank_col]  # [T, D]; -1 = no bank
+        banked = slots >= 0
+        live = banked[1:].any(axis=1)  # per tick t >= 1
+        deferred = st[1:, ci] > BANK_BEFORE_F
+        out[_OVERLAP_KEYS[ci]] = {
+            "exposed_hop_ticks": int((live & ~deferred).sum()),
+            "overlappable_hop_ticks": int((live & deferred).sum()),
+        }
+        # soundness: no unit ordered before the bank touches the slot
+        for label, unit_stage, m_col, slot_cols in _OVERLAP_UNIT_TOUCHES:
+            if not (st[:, ci] > unit_stage).any():
+                continue
+            on = table[:, :, m_col] >= 0
+            for slot_col, k in slot_cols:
+                if k != kind:
+                    continue
+                touch = (table[:, :, slot_col] >= 0
+                         if slot_col in (COL_FWD_LOCAL_SLOT,
+                                         COL_BWD_LOCAL_SLOT)
+                         else on)
+                bad = (banked & touch & (table[:, :, slot_col] == slots)
+                       & (st[:, ci] > unit_stage)[:, None])
+                for t, d in np.argwhere(bad):
+                    hazards.append(Hazard(
+                        "overlap-stage", int(d), int(t),
+                        COLUMN_NAMES[bank_col],
+                        f"bank of slot {int(slots[t, d])} deferred to stage "
+                        f"{int(st[t, ci])} but the {label} unit "
+                        f"({COLUMN_NAMES[slot_col]}) touches it at stage "
+                        f"{unit_stage}"))
+    # same-buffer channels landing in the same slot must bank in lockstep
+    # order, which the executor only preserves inside one stage
+    for i, j in ((0, 2), (1, 3)):
+        si = table[:, :, OVERLAP_CHANNELS[i][0]]
+        sj = table[:, :, OVERLAP_CHANNELS[j][0]]
+        clash = (si >= 0) & (sj >= 0) & (si == sj)
+        for t in np.nonzero(clash.any(axis=1))[0]:
+            if st[t, i] != st[t, j]:
+                d = int(np.nonzero(clash[t])[0][0])
+                hazards.append(Hazard(
+                    "overlap-order", d, int(t),
+                    COLUMN_NAMES[OVERLAP_CHANNELS[j][0]],
+                    f"channels {_OVERLAP_KEYS[i]}/{_OVERLAP_KEYS[j]} bank "
+                    f"slot {int(si[t, d])} at different stages "
+                    f"({int(st[t, i])} vs {int(st[t, j])})"))
     return out
 
 
@@ -659,7 +768,8 @@ class _TrainInterp:
             grad_live_peak=[g.live_peak for g in self.grad],
             n_act_slots=cs.n_act_slots, n_grad_slots=cs.n_grad_slots,
             comm=_comm_volume(table), unit_counts=unit_counts,
-            compression=comp)
+            compression=comp,
+            overlap=_overlap_discipline(table, hazards))
 
 
 def check_table(cs: CompiledSchedule) -> TableReport:

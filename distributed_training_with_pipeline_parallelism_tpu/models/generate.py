@@ -85,7 +85,8 @@ def _attend_cached(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 def _layer_step(cfg: ModelConfig, lp: Pytree, h: jax.Array, k_cache: jax.Array,
                 v_cache: jax.Array, offset: jax.Array,
                 rope_slice: Optional[jax.Array],
-                tp_axis: Optional[str] = None, tp_size: int = 1
+                tp_axis: Optional[str] = None, tp_size: int = 1,
+                prefill: bool = False
                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One block over S new positions; writes their k/v into the cache at
     ``offset`` and returns (h_out, k_cache, v_cache).
@@ -95,7 +96,17 @@ def _layer_step(cfg: ModelConfig, lp: Pytree, h: jax.Array, k_cache: jax.Array,
     head shards — the KV cache holds ``Hkv/tp_size`` heads per model
     rank), o and the MLP down-projection row-parallel with one psum each.
     Decode is where TP shines — small batch, weight-read bound — and the
-    weight reads split ``tp_size`` ways."""
+    weight reads split ``tp_size`` ways.
+
+    ``prefill=True`` is a STATIC promise by the caller that ``offset`` is
+    zero and the cache holds nothing before this call — the S new
+    positions are the whole sequence, so their attention is plain causal
+    self-attention over the new block. Under that promise the call is
+    eligible for the Pallas flash kernel with the training path's exact
+    fallback discipline (``cfg.flash_for``: 'auto' = causal TPU
+    sequences >= 1024, dense elsewhere); sites with traced offsets —
+    decode steps, the serving engine's chunked prefill — must keep the
+    default and stay on the cached dense path."""
     b, s, _ = h.shape
     n_heads = cfg.n_heads // tp_size
     n_kv = (cfg.n_kv_heads or cfg.n_heads) // tp_size
@@ -114,14 +125,24 @@ def _layer_step(cfg: ModelConfig, lp: Pytree, h: jax.Array, k_cache: jax.Array,
                                            (0, offset, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
                                            (0, offset, 0, 0))
-    att = _attend_cached(q, k_cache, v_cache, offset, n_heads,
-                         cfg.sliding_window)
+    if prefill and cfg.flash_for(True, s):
+        # the new block IS the whole visible sequence (offset==0 promise),
+        # so attend q against the pre-cache k/v through the flash kernel —
+        # the cached tail is all masked zeros either way
+        from ..ops.pallas_attention import flash_attention
+        kf, vf = gqa_expand(k, v, n_heads)
+        att = flash_attention(q, kf, vf, causal=True,
+                              window=cfg.sliding_window).reshape(b, s, -1)
+    else:
+        att = _attend_cached(q, k_cache, v_cache, offset, n_heads,
+                             cfg.sliding_window)
     if tp_axis is None:
         attn = linear_apply(ap["o"], att)
     else:
         from ..ops.collectives import tp_output_projection
         attn = tp_output_projection(ap["o"], att, tp_axis)
-    return mlp_block(cfg, lp, h + attn, tp_axis=tp_axis), k_cache, v_cache
+    return (mlp_block(cfg, lp, h + attn, tp_axis=tp_axis, tp_size=tp_size),
+            k_cache, v_cache)
 
 
 def _embed_at(cfg: ModelConfig, embed: Pytree, tokens: jax.Array,
@@ -156,29 +177,34 @@ def rope_slice_at(cfg: ModelConfig, max_len: int, offset: jax.Array,
 def layers_with_cache(cfg: ModelConfig, layers: Pytree, h: jax.Array,
                       k_cache: jax.Array, v_cache: jax.Array,
                       offset: jax.Array, rope_slice: Optional[jax.Array],
-                      tp_axis: Optional[str] = None, tp_size: int = 1
+                      tp_axis: Optional[str] = None, tp_size: int = 1,
+                      prefill: bool = False
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Scan a stack of blocks over S new positions with per-layer KV
     caches [L, B, T, Hkv(/tp_size), hd]. Shared by the single-device
     decode and the pipelined decode's stage bodies (each stage passes its
     layer slice and cache shard; with ``tp_axis`` the layer leaves are
-    Megatron model-axis shards)."""
+    Megatron model-axis shards). ``prefill`` flags statically-zero-offset
+    fresh-cache calls as flash-eligible (see :func:`_layer_step`)."""
     def body(carry, xs):
         lp, kc, vc = xs
         h, kc, vc = _layer_step(cfg, lp, carry, kc, vc, offset, rope_slice,
-                                tp_axis=tp_axis, tp_size=tp_size)
+                                tp_axis=tp_axis, tp_size=tp_size,
+                                prefill=prefill)
         return h, (kc, vc)
 
     return jax.lax.scan(body, h, (layers, k_cache, v_cache))
 
 
 def _forward_with_cache(cfg: ModelConfig, params: Pytree, cache: Pytree,
-                        tokens: jax.Array, offset: jax.Array
+                        tokens: jax.Array, offset: jax.Array,
+                        prefill: bool = False
                         ) -> Tuple[jax.Array, Pytree]:
     """Run S new tokens (global positions offset..offset+S-1) through the model.
 
     Returns (last-position logits [B, V], updated cache). Serves as both
-    prefill (offset=0, S=prompt_len) and decode step (S=1).
+    prefill (offset=0, S=prompt_len, pass ``prefill=True`` for the flash
+    fast path) and decode step (S=1).
     """
     if cfg.arch not in ("gpt2", "llama"):
         raise ValueError(
@@ -195,6 +221,22 @@ def _forward_with_cache(cfg: ModelConfig, params: Pytree, cache: Pytree,
     logits = head_apply(cfg, params["head"], h[:, -1:],
                         embed=params["embed"])[:, 0]
     return logits, {"k": k_new, "v": v_new}
+
+
+def token_logprob(cfg: ModelConfig, logits: jax.Array,
+                  tok: jax.Array) -> jax.Array:
+    """Log-probability [B] f32 of the chosen token ``tok`` [B] under
+    ``logits`` [B, V] — the decode-path twin of the training loss core:
+    ``cfg.use_fused_xent`` routes through the Pallas fused-NLL kernel
+    (``ops.pallas_xent``, which never materializes the [B, V]
+    log-softmax), the default through the XLA formulation. Identical
+    values either way (the kernel is tested against the formulation)."""
+    if cfg.use_fused_xent:
+        from ..ops.pallas_xent import fused_softmax_xent
+        return -fused_softmax_xent(logits, tok.astype(jnp.int32))
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logz, tok.astype(jnp.int32)[:, None],
+                               axis=-1)[:, 0]
 
 
 def sample_logits(key: Optional[jax.Array], logits: jax.Array,
@@ -231,7 +273,8 @@ def generate(cfg: ModelConfig, params: Pytree, prompt: jax.Array,
              top_p: Optional[float] = None,
              max_len: Optional[int] = None,
              eos_id: Optional[int] = None,
-             return_lengths: bool = False) -> jax.Array:
+             return_lengths: bool = False,
+             return_logprobs: bool = False) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` [B, P].
 
     Returns [B, P + max_new_tokens]. Pure and jittable (see
@@ -247,6 +290,13 @@ def generate(cfg: ModelConfig, params: Pytree, prompt: jax.Array,
     tokens per row including the EOS itself (N when no EOS appeared).
     These are exactly the freeze semantics of the pipelined decoder and
     the serving executor, so all three stay token-for-token comparable.
+
+    With ``return_logprobs=True`` the result additionally carries the
+    emitted tokens' log-probabilities [B, N] f32 (appended last), each
+    computed from the same logits its token was sampled from through
+    :func:`token_logprob` (``cfg.use_fused_xent`` routes the Pallas
+    fused-NLL kernel). EOS-frozen rows report 0.0 for their forced
+    tokens — forced, not sampled — matching the pipelined decoder.
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -269,48 +319,92 @@ def generate(cfg: ModelConfig, params: Pytree, prompt: jax.Array,
         raise ValueError("sampling (temperature != 0) requires a PRNG key")
     cache = init_cache(cfg, b, max_len)
     logits, cache = _forward_with_cache(cfg, params, cache, prompt,
-                                        jnp.int32(0))
+                                        jnp.int32(0), prefill=True)
     keys = jax.random.split(key if key is not None else jax.random.key(0),
                             max_new_tokens)
     first = sample_logits(keys[0], logits, temperature, top_k, top_p)
 
-    if eos_id is None:
-        def step(carry, step_key):
-            cache, tok, pos = carry
-            logits, cache = _forward_with_cache(cfg, params, cache,
-                                                tok[:, None], pos)
-            nxt = sample_logits(step_key, logits, temperature, top_k, top_p)
-            return (cache, nxt, pos + 1), tok
+    lps = None
+    if not return_logprobs:
+        if eos_id is None:
+            def step(carry, step_key):
+                cache, tok, pos = carry
+                logits, cache = _forward_with_cache(cfg, params, cache,
+                                                    tok[:, None], pos)
+                nxt = sample_logits(step_key, logits, temperature, top_k,
+                                    top_p)
+                return (cache, nxt, pos + 1), tok
 
-        (_, last, _), toks = jax.lax.scan(step, (cache, first, jnp.int32(p)),
-                                          keys[1:])
+            (_, last, _), toks = jax.lax.scan(
+                step, (cache, first, jnp.int32(p)), keys[1:])
+        else:
+            # a row is done once the token it is ABOUT to consume is EOS —
+            # that token's KV never enters the cache and all later emissions
+            # are forced to eos_id (same freeze rule as pipelined_decode)
+            def step(carry, step_key):
+                cache, tok, pos, done = carry
+                logits, cache2 = _forward_with_cache(cfg, params, cache,
+                                                     tok[:, None], pos)
+                m = done[None, :, None, None, None]
+                cache = jax.tree.map(lambda old, new: jnp.where(m, old, new),
+                                     cache, cache2)
+                nxt = sample_logits(step_key, logits, temperature, top_k,
+                                    top_p)
+                nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+                return (cache, nxt, pos + 1, done | (nxt == eos_id)), tok
+
+            done0 = first == eos_id
+            (_, last, _, _), toks = jax.lax.scan(
+                step, (cache, first, jnp.int32(p), done0), keys[1:])
     else:
-        # a row is done once the token it is ABOUT to consume is EOS —
-        # that token's KV never enters the cache and all later emissions
-        # are forced to eos_id (same freeze rule as pipelined_decode)
-        def step(carry, step_key):
-            cache, tok, pos, done = carry
-            logits, cache2 = _forward_with_cache(cfg, params, cache,
-                                                 tok[:, None], pos)
-            m = done[None, :, None, None, None]
-            cache = jax.tree.map(lambda old, new: jnp.where(m, old, new),
-                                 cache, cache2)
-            nxt = sample_logits(step_key, logits, temperature, top_k, top_p)
-            nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
-            return (cache, nxt, pos + 1, done | (nxt == eos_id)), tok
+        # same loops with the token's logprob riding the carry; kept as a
+        # separate Python branch so the default jaxpr is untouched
+        lp0 = token_logprob(cfg, logits, first)
+        if eos_id is None:
+            def step(carry, step_key):
+                cache, tok, lp, pos = carry
+                logits, cache = _forward_with_cache(cfg, params, cache,
+                                                    tok[:, None], pos)
+                nxt = sample_logits(step_key, logits, temperature, top_k,
+                                    top_p)
+                return (cache, nxt, token_logprob(cfg, logits, nxt),
+                        pos + 1), (tok, lp)
 
-        done0 = first == eos_id
-        (_, last, _, _), toks = jax.lax.scan(
-            step, (cache, first, jnp.int32(p), done0), keys[1:])
+            (_, last, last_lp, _), (toks, lp_toks) = jax.lax.scan(
+                step, (cache, first, lp0, jnp.int32(p)), keys[1:])
+        else:
+            def step(carry, step_key):
+                cache, tok, lp, pos, done = carry
+                logits, cache2 = _forward_with_cache(cfg, params, cache,
+                                                     tok[:, None], pos)
+                m = done[None, :, None, None, None]
+                cache = jax.tree.map(lambda old, new: jnp.where(m, old, new),
+                                     cache, cache2)
+                nxt = sample_logits(step_key, logits, temperature, top_k,
+                                    top_p)
+                # frozen rows emit FORCED eos, not a sample: logprob 0.0
+                nlp = jnp.where(done, 0.0, token_logprob(cfg, logits, nxt))
+                nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+                return (cache, nxt, nlp, pos + 1,
+                        done | (nxt == eos_id)), (tok, lp)
+
+            done0 = first == eos_id
+            (_, last, last_lp, _, _), (toks, lp_toks) = jax.lax.scan(
+                step, (cache, first, lp0, jnp.int32(p), done0), keys[1:])
+        lps = jnp.concatenate([jnp.moveaxis(lp_toks, 0, 1),
+                               last_lp[:, None]], axis=1)
 
     new = jnp.concatenate([jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
     out = jnp.concatenate([prompt, new.astype(prompt.dtype)], axis=1)
-    if not return_lengths:
-        return out
-    hit = new == eos_id
-    lengths = jnp.where(hit.any(axis=1), jnp.argmax(hit, axis=1) + 1,
-                        max_new_tokens).astype(jnp.int32)
-    return out, lengths
+    res = (out,)
+    if return_lengths:
+        hit = new == eos_id
+        lengths = jnp.where(hit.any(axis=1), jnp.argmax(hit, axis=1) + 1,
+                            max_new_tokens).astype(jnp.int32)
+        res = res + (lengths,)
+    if return_logprobs:
+        res = res + (lps,)
+    return res if len(res) > 1 else out
 
 
 def make_generate_fn(cfg: ModelConfig, max_new_tokens: int, *,
@@ -318,10 +412,12 @@ def make_generate_fn(cfg: ModelConfig, max_new_tokens: int, *,
                      top_p: Optional[float] = None,
                      max_len: Optional[int] = None,
                      eos_id: Optional[int] = None,
-                     return_lengths: bool = False):
+                     return_lengths: bool = False,
+                     return_logprobs: bool = False):
     """Jitted (params, prompt, key) -> tokens closure over the static knobs."""
     fn = functools.partial(generate, cfg, max_new_tokens=max_new_tokens,
                            temperature=temperature, top_k=top_k, top_p=top_p,
                            max_len=max_len, eos_id=eos_id,
-                           return_lengths=return_lengths)
+                           return_lengths=return_lengths,
+                           return_logprobs=return_logprobs)
     return jax.jit(lambda params, prompt, key=None: fn(params, prompt, key=key))

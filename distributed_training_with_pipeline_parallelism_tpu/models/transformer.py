@@ -122,8 +122,8 @@ def layer_apply(cfg: ModelConfig, params: Dict, h: jax.Array,
                          flash=fl, tp_axis=tp_axis, tp_size=tp_size,
                          dropout_rate=p, dropout_rng=site(0))
         h = h + dropout_apply(attn, p, site(1))
-        return mlp_block(cfg, params, h, tp_axis=tp_axis, rng=site(2),
-                         dropout=p)
+        return mlp_block(cfg, params, h, tp_axis=tp_axis, tp_size=tp_size,
+                         rng=site(2), dropout=p)
     if cfg.arch == "llama":
         a = rms_norm_apply(params["rms1"], h, cfg.rms_eps)
         attn = mha_apply(params["attn"], a, a, heads, causal=cfg.causal,
@@ -131,8 +131,8 @@ def layer_apply(cfg: ModelConfig, params: Dict, h: jax.Array,
                          tp_size=tp_size, window=cfg.sliding_window,
                          dropout_rate=p, dropout_rng=site(0))
         h = h + dropout_apply(attn, p, site(1))
-        return mlp_block(cfg, params, h, tp_axis=tp_axis, rng=site(2),
-                         dropout=p)
+        return mlp_block(cfg, params, h, tp_axis=tp_axis, tp_size=tp_size,
+                         rng=site(2), dropout=p)
     raise ValueError(f"unknown arch {cfg.arch!r}")
 
 
@@ -151,13 +151,26 @@ def _ffn_out(params: Dict, z: jax.Array, tp_axis: Optional[str]) -> jax.Array:
 
 
 def mlp_block(cfg: ModelConfig, params: Dict, h: jax.Array,
-              tp_axis: Optional[str] = None,
+              tp_axis: Optional[str] = None, tp_size: int = 1,
               rng: Optional[jax.Array] = None, dropout: float = 0.0) -> jax.Array:
     """Post-attention half of a gpt2/llama block (norm + MLP + residual).
 
     Shared between the training path (:func:`layer_apply`) and the KV-cache
     decode path (:mod:`.generate`, which never passes an rng) so the two
-    cannot drift. ``rng`` applies residual-branch dropout to the MLP output."""
+    cannot drift. ``rng`` applies residual-branch dropout to the MLP output.
+
+    With ``cfg.tp_overlap`` resolving to ``"ring"`` (TP only, dropout-free,
+    seq divisible by ``tp_size``), the block's TP boundary runs the
+    collective-matmul forms instead of the replicated copy/psum pair: the
+    sequence is sharded at the norm output, the all-gather overlaps the
+    up-projection and the reduce-scatter the down-projection, and the
+    residual re-replicates via one ring gather (see
+    :mod:`..ops.collectives`)."""
+    if (tp_axis is not None and tp_size > 1 and cfg.tp_overlap != "none"
+            and (rng is None or dropout == 0.0)):
+        from ..parallel.tensor_parallel import resolve_tp_overlap
+        if resolve_tp_overlap(cfg.tp_overlap, tp_size, h.shape[1]) == "ring":
+            return _mlp_block_ring(cfg, params, h, tp_axis, tp_size)
     # the activations are checkpointed: backward saves only the [.., ffn]
     # pre-activation and recomputes the (tanh-)gelu/silu chain — without
     # this autodiff banks ~6 ffn-sized intermediates per layer, the
@@ -177,6 +190,38 @@ def mlp_block(cfg: ModelConfig, params: Dict, h: jax.Array,
                       linear_apply(params["w3"], m)),
                   tp_axis)
     return h + dropout_apply(ff, dropout, rng)
+
+
+def _mlp_block_ring(cfg: ModelConfig, params: Dict, h: jax.Array,
+                    tp_axis: str, tp_size: int) -> jax.Array:
+    """Collective-matmul MLP: sequence-shard the norm output (free slice of
+    a replicated value), overlap the gather with the up-projection and the
+    scatter with the down-projection, re-replicate for the residual. The
+    up-projection is bit-identical to the unfused path; the down-projection
+    sums partials in ring order (numerical, not bitwise, parity)."""
+    from ..ops.collectives import seq_all_gather, seq_scatter
+    from ..parallel.tensor_parallel import (tp_all_gather_matmul,
+                                            tp_matmul_reduce_scatter)
+    if cfg.arch == "gpt2":
+        m = seq_scatter(layer_norm_apply(params["ln2"], h), tp_axis, tp_size)
+        z = tp_all_gather_matmul(m, params["lin1"]["w"], tp_axis, tp_size,
+                                 mode="ring") + params["lin1"]["b"]
+        ff = tp_matmul_reduce_scatter(jax.checkpoint(jax.nn.gelu)(z),
+                                      params["lin2"]["w"], tp_axis, tp_size,
+                                      mode="ring")
+        ff = seq_all_gather(ff, tp_axis, tp_size) + params["lin2"]["b"]
+        return h + ff
+    m = seq_scatter(rms_norm_apply(params["rms2"], h, cfg.rms_eps),
+                    tp_axis, tp_size)
+    act = jax.nn.silu if cfg.mlp_act == "silu" else jax.nn.gelu
+    z1 = tp_all_gather_matmul(m, params["w1"]["w"], tp_axis, tp_size,
+                              mode="ring")
+    z3 = tp_all_gather_matmul(m, params["w3"]["w"], tp_axis, tp_size,
+                              mode="ring")
+    ff = tp_matmul_reduce_scatter(
+        jax.checkpoint(lambda a, b: act(a) * b)(z1, z3),
+        params["w2"]["w"], tp_axis, tp_size, mode="ring")
+    return h + seq_all_gather(ff, tp_axis, tp_size)
 
 
 # ---------------------------------------------------------------------------

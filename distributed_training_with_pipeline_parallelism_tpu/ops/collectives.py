@@ -12,6 +12,25 @@ transposes:
 - :func:`tp_reduce` — **psum forward**, identity backward. Completes a
   row-parallel matmul: partial outputs are summed; the output cotangent is
   already replicated and flows to every shard unchanged.
+
+On top of the conjugate pairs, the **collective-matmul** forms overlap TP
+communication with the matmuls that consume it (Wang et al.,
+arXiv:2211.05102 — the "collective matmul" decomposition TPU compilers
+apply to Megatron blocks):
+
+- :func:`all_gather_matmul` — ``all_gather(x) @ w`` as a ring of
+  ``axis_size - 1`` ppermute hops, each issued *before* the chunk matmul
+  it overlaps with, so the gather rides under the up-projection;
+- :func:`matmul_reduce_scatter` — ``reduce_scatter(z @ w)`` as the
+  conjugate ring: partial chunk products accumulate along the ring, each
+  hop overlapping the next chunk's down-projection matmul;
+- :func:`seq_scatter` / :func:`seq_all_gather` — the replicated <->
+  sequence-sharded boundary conversions (slice forward / ring gather
+  forward, with the conjugate transposes as ``custom_vjp``s).
+
+All ring forms are plain differentiable JAX (``ppermute`` has an exact
+transpose), so backward passes get the same overlapped ring structure for
+free, and the portable form runs bit-for-bit on the CPU proxy mesh.
 """
 
 from __future__ import annotations
@@ -137,3 +156,139 @@ def vocab_parallel_masked_xent_sum(logits_local: jax.Array,
     nll = _vocab_parallel_nll(logits_local, targets, axis_name)
     valid = targets != pad_id
     return jnp.sum(jnp.where(valid, nll, 0.0)), jnp.sum(valid)
+
+
+# ---------------------------------------------------------------------------
+# Collective matmul: ring-overlapped all-gather/reduce-scatter fused with
+# the projections that consume them (arXiv:2211.05102 §3.3)
+# ---------------------------------------------------------------------------
+
+
+def _ring_perm(axis_size: int, offset: int):
+    return [(i, (i + offset) % axis_size) for i in range(axis_size)]
+
+
+def all_gather_matmul(x_loc: jax.Array, w: jax.Array, axis_name: str,
+                      axis_size: int) -> jax.Array:
+    """``all_gather(x, seq) @ w`` with the gather overlapped into the matmul.
+
+    ``x_loc``: this rank's sequence chunk ``[B, C, d]`` (chunk index =
+    rank); ``w``: the column-parallel local shard ``[d, F_loc]``. Returns
+    the full-sequence column-sharded product ``[B, T*C, F_loc]``.
+
+    Ring decomposition: at step ``k`` the rank holds chunk ``(my + k) % T``
+    — it issues the ppermute fetching the *next* chunk first, then runs
+    the current chunk's matmul, so the hop and the matmul are independent
+    ops the latency-hiding scheduler overlaps. Per-row-block matmul is
+    exact, so the result is bit-identical to gather-then-matmul.
+    Differentiable as-is: the transposed ring has the same overlapped
+    structure (ppermute transposes to the inverse ppermute).
+    """
+    T = int(axis_size)
+    my = jax.lax.axis_index(axis_name)
+    B, C, _ = x_loc.shape
+    out = jnp.zeros((B, T * C, w.shape[-1]), dtype=jnp.result_type(x_loc, w))
+    # receive from (i+1): after k hops we hold chunk (my + k) % T
+    perm = _ring_perm(T, -1)
+    chunk = x_loc
+    for k in range(T):
+        nxt = (jax.lax.ppermute(chunk, axis_name, perm)
+               if k + 1 < T else None)  # issued before the overlapping matmul
+        blk = chunk @ w
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, blk, ((my + k) % T) * C, axis=1)
+        chunk = nxt
+    return out
+
+
+def matmul_reduce_scatter(z: jax.Array, w: jax.Array, axis_name: str,
+                          axis_size: int) -> jax.Array:
+    """``reduce_scatter(z @ w, seq)`` with the scatter overlapped into the
+    matmul.
+
+    ``z``: full-sequence column-sharded activations ``[B, T*C, F_loc]``;
+    ``w``: the row-parallel local shard ``[F_loc, d]``. Returns this
+    rank's sequence chunk of the cross-rank partial sum ``[B, C, d]`` —
+    i.e. chunk ``my`` of ``psum_r(z_r @ w_r)``.
+
+    Ring decomposition: the accumulator travels the ``+1`` ring; at step
+    ``k`` each rank adds its product for the chunk destined ``T - 1 - k``
+    hops downstream, so every hop overlaps the next chunk's matmul.
+    Summation order is the fixed ring order (deterministic, but not the
+    same reduction tree as ``psum`` — parity with the unfused form is
+    numerical, not bitwise).
+    """
+    T = int(axis_size)
+    my = jax.lax.axis_index(axis_name)
+    C = z.shape[1] // T
+    perm = _ring_perm(T, +1)
+    acc = None
+    for k in range(T):
+        idx = ((my - k - 1) % T) * C
+        blk = jax.lax.dynamic_slice_in_dim(z, idx, C, axis=1) @ w
+        # hop first (independent of this step's matmul), add after
+        acc = blk if acc is None else jax.lax.ppermute(
+            acc, axis_name, perm) + blk
+    return acc
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def seq_scatter(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """Replicated ``[B, S, d]`` -> this rank's sequence chunk
+    ``[B, S/T, d]``. Free forward (a slice of a replicated value); the
+    backward is the conjugate chunk gather — each rank's cotangent chunk
+    is distinct, and the replicated input's cotangent is their
+    concatenation."""
+    my = jax.lax.axis_index(axis_name)
+    c = x.shape[1] // axis_size
+    return jax.lax.dynamic_slice_in_dim(x, my * c, c, axis=1)
+
+
+def _seq_scatter_fwd(x, axis_name, axis_size):
+    return seq_scatter(x, axis_name, axis_size), None
+
+
+def _seq_scatter_bwd(axis_name, axis_size, _, g):
+    return (seq_all_gather(g, axis_name, axis_size),)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def seq_all_gather(x_loc: jax.Array, axis_name: str,
+                   axis_size: int) -> jax.Array:
+    """Sequence chunks ``[B, S/T, d]`` (chunk index = rank) -> the full
+    replicated ``[B, S, d]``, gathered over the ring. Backward is the
+    conjugate slice: the output cotangent is replicated, each rank keeps
+    its own chunk."""
+    T = int(axis_size)
+    my = jax.lax.axis_index(axis_name)
+    B, C, d = x_loc.shape
+    out = jnp.zeros((B, T * C, d), dtype=x_loc.dtype)
+    perm = _ring_perm(T, -1)
+    chunk = x_loc
+    for k in range(T):
+        nxt = (jax.lax.ppermute(chunk, axis_name, perm)
+               if k + 1 < T else None)
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, chunk, ((my + k) % T) * C, axis=1)
+        chunk = nxt
+    return out
+
+
+def _seq_all_gather_fwd(x_loc, axis_name, axis_size):
+    return seq_all_gather(x_loc, axis_name, axis_size), None
+
+
+def _seq_all_gather_bwd(axis_name, axis_size, _, g):
+    return (seq_scatter(g, axis_name, axis_size),)
+
+
+seq_scatter.defvjp(_seq_scatter_fwd, _seq_scatter_bwd)
+seq_all_gather.defvjp(_seq_all_gather_fwd, _seq_all_gather_bwd)
+
+
+def ring_matmul_hops(axis_size: int, n_collective_matmuls: int) -> int:
+    """ppermute hops the ring collective-matmul forms trace: each
+    :func:`all_gather_matmul` / :func:`matmul_reduce_scatter` /
+    :func:`seq_all_gather` contributes ``axis_size - 1`` (the census the
+    jaxpr auditor pins for TP-overlap programs)."""
+    return (int(axis_size) - 1) * int(n_collective_matmuls)

@@ -54,12 +54,15 @@ from ..ops.layers import (global_pad_scale, linear_apply,
 from ..utils.config import ModelConfig, ScheduleConfig
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS,
                    SEQ_AXIS)
-from .schedules import (COL_BWD_ASLOT, COL_BWD_GSLOT, COL_BWD_LOCAL_SLOT,
-                        COL_BWD_M, COL_BWD_V, COL_FWD_LOCAL_SLOT, COL_FWD_M,
+from .schedules import (BANK_BEFORE_B, BANK_BEFORE_F, BANK_BEFORE_W,
+                        BANK_END, COL_BWD_ASLOT, COL_BWD_GSLOT,
+                        COL_BWD_LOCAL_SLOT, COL_BWD_M, COL_BWD_V,
+                        COL_FWD_LOCAL_SLOT, COL_FWD_M,
                         COL_FWD_SLOT, COL_FWD_V, COL_STORE_B_POS_SLOT,
                         COL_STORE_B_SLOT, COL_STORE_F_NEG_SLOT,
                         COL_STORE_F_SLOT, COL_W_ASLOT, COL_W_GSLOT, COL_W_M,
-                        COL_W_V, CompiledSchedule, compile_schedule)
+                        COL_W_V, CompiledSchedule, compile_schedule,
+                        overlap_bank_stages)
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -479,7 +482,8 @@ _PHASE_TRACE_HOOK = None
 logger = logging.getLogger(__name__)
 
 
-def _phase_compressed_ticks(tick, carry, table, phases, telemetry=None):
+def _phase_compressed_ticks(tick, carry, table, phases, telemetry=None,
+                            bank_stages=None):
     """Drive a tick program as per-phase ``lax.scan`` s with per-pattern
     specialized bodies — the ``unroll_ticks="phases"`` executor core,
     shared by the training and forward-only programs.
@@ -509,7 +513,15 @@ def _phase_compressed_ticks(tick, carry, table, phases, telemetry=None):
     scalars drawn from the live carry — dataflow pins phase j's start
     stamp after phase j-1's work and its end stamp after its own, giving a
     measured per-phase timeline aligned with the ``phases`` descriptors.
-    When None (default), no callback is emitted at all."""
+    When None (default), no callback is emitted at all.
+
+    ``bank_stages`` (opt-in, ``[T, 4]`` int from ``..schedules.
+    overlap_bank_stages``) enables the double-buffered ring discipline:
+    each body position banks its ring arrivals at the per-position stage
+    folded over every tick the position covers (min across blocks —
+    banking earlier than latest-safe is always lockstep-correct). The
+    stage tuple joins the memo key, so two phases sharing a mask pattern
+    but differing in bank stages compile separate bodies."""
     from ..utils import telemetry as _tm
     memo = {}
     n_cols = phases[0].base.shape[-1]
@@ -536,17 +548,31 @@ def _phase_compressed_ticks(tick, carry, table, phases, telemetry=None):
             # phase; the body is one program for all blocks, so take the
             # union (0 = active wins)
             succ = np.maximum(succ, pseudo(masks_q[0]))
-        key = (q, masks_q.tobytes(), succ.tobytes())
+        if bank_stages is None:
+            st_q = None
+        else:
+            st_q = bank_stages[ph.start:ph.start + L].reshape(
+                L // q, q, -1).min(axis=0)  # [q, 4]
+        key = (q, masks_q.tobytes(), succ.tobytes(),
+               None if st_q is None else st_q.tobytes())
         if key not in memo:
             rows_c = [pseudo(m) for m in masks_q]
             nxts = rows_c[1:] + [succ]
+            stages_c = ([None] * q if st_q is None
+                        else [tuple(int(v) for v in st_q[i])
+                              for i in range(q)])
 
-            def body(c, xs, _rows=rows_c, _nxts=nxts):
+            def body(c, xs, _rows=rows_c, _nxts=nxts, _stages=stages_c):
                 if _PHASE_TRACE_HOOK is not None:
                     _PHASE_TRACE_HOOK()
                 with jax.named_scope("pp/tick_body"):
                     for i, (rc, nc) in enumerate(zip(_rows, _nxts)):
-                        c, _ = tick(c, xs[i], concrete=rc, next_concrete=nc)
+                        # kwarg only when staged: the forward-only tick
+                        # (which shares this driver) stays lockstep
+                        kw = ({} if _stages[i] is None
+                              else {"bank_stages": _stages[i]})
+                        c, _ = tick(c, xs[i], concrete=rc, next_concrete=nc,
+                                    **kw)
                 return c, None
 
             memo[key] = body
@@ -569,6 +595,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                           unroll_ticks=None,
                           telemetry=None,
                           dynamics=None,
+                          comm_overlap: str = "none",
                           ) -> Callable[[Pytree, jax.Array, jax.Array],
                                         Tuple[jax.Array, Pytree]]:
     """Build an (unjitted) ``(params, tokens, targets) -> (loss, grads)``
@@ -680,6 +707,28 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     traced program is byte-identical to a build without the argument
     (tests/test_dynamics.py pins the jaxpr).
 
+    ``comm_overlap`` selects the ring-hop discipline (docs/performance.md
+    "Comm/compute overlap"):
+
+    - ``"none"`` (default): lockstep — every tick banks last tick's ring
+      arrivals into the edge slots at the tick top, so each ppermute is a
+      data dependency of ALL of the next tick's compute.
+    - ``"ring"``: double-buffered edge slots. The recv register a
+      ppermute lands in is held across the next tick's units and
+      committed to its edge slot only at the channel's bank stage — the
+      latest point the static classifier
+      (:func:`..schedules.overlap_bank_stages`) proves conflict-free —
+      so the hop overlaps every unit that doesn't read or write the
+      banked slot (in 1F1B's steady state the grad arrival is consumed
+      by B, which runs AFTER F: the backward ring hop overlaps the whole
+      forward unit). Bit-identical to ``"none"`` by construction
+      (tests/test_overlap.py). Requires the unrolled or phase-compressed
+      executor — the cond-dispatched scan sees only traced rows, so
+      ``unroll_ticks=False`` raises.
+    - ``"auto"``: ``"ring"`` whenever the resolved executor supports it
+      (unrolled / phases), ``"none"`` otherwise (scan, phase-stored,
+      degenerate 1-stage).
+
     ``fsdp=True`` (pp x fsdp, ZeRO-3 within the pipeline): per-stage layer
     weights live sharded over the 'data' axis (per-leaf weight dim from
     :func:`_fsdp_shard_dims` — use :func:`fsdp_shard_params` to place
@@ -742,6 +791,9 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     # they occupy expert capacity, so load balance legitimately counts them)
     if moe is not None:
         _check_moe_mesh(cfg, moe, T, n_seq, n_ep)
+    if comm_overlap not in ("none", "ring", "auto"):
+        raise ValueError(f"comm_overlap must be 'none', 'ring', or 'auto', "
+                         f"got {comm_overlap!r}")
     dyn = bool(dynamics)
     if dyn:
         blockers = []
@@ -837,6 +889,13 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 "phase-stored program differentiates through its forward "
                 "tick scan and never materializes them — pass "
                 "remat_backward=True for the tick executor")
+        if comm_overlap == "ring":
+            raise ValueError(
+                "comm_overlap='ring' is incompatible with the phase-stored "
+                "backward (it differentiates through the forward tick scan "
+                "and has no per-tick bank sites) — pass remat_backward="
+                "True/None for the tick executor, or comm_overlap='auto' "
+                "to fall back to lockstep here")
         fn = _make_phase_stored_grad_fn(cfg, mesh, sched, sp_attn_impl,
                                         tp_vocab_parallel)
         if telemetry is None:
@@ -873,6 +932,16 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     if unroll_ticks not in (True, False, "phases"):
         raise ValueError(f"unroll_ticks must be True, False, 'phases', or "
                          f"None (auto), got {unroll_ticks!r}")
+    if comm_overlap == "auto":
+        comm_overlap = "ring" if unroll_ticks in (True, "phases") else "none"
+    elif comm_overlap == "ring" and unroll_ticks is False:
+        raise ValueError(
+            "comm_overlap='ring' needs static per-tick bank stages; the "
+            "cond-dispatched scan executor (unroll_ticks=False) sees only "
+            "traced rows — use unroll_ticks=True or 'phases' (or "
+            "comm_overlap='auto' to fall back to lockstep)")
+    bank_stages_tab = (overlap_bank_stages(cs.table)
+                       if comm_overlap == "ring" else None)
     if unroll_ticks == "phases":
         from .schedules import compress_schedule
         phases = compress_schedule(cs.table)
@@ -1172,7 +1241,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             return sum((jnp.sum(jnp.square(l.astype(jnp.float32)))
                         for l in jax.tree.leaves(t)), jnp.float32(0.0))
 
-        def tick(carry, row_all, concrete=None, next_concrete=None):
+        def tick(carry, row_all, concrete=None, next_concrete=None,
+                 bank_stages=None):
             if dyn:
                 (act_buf, grad_buf, res_bufs, recvs,
                  g_layers, g_embed, g_head, loss_acc, sq_mb) = carry
@@ -1192,12 +1262,31 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     return buf
                 return masked_store(buf, val, row[col])
 
-            # 1. bank arrivals from last tick's ppermute channels
-            act_buf = store(act_buf, recvs[0], COL_STORE_F_SLOT)
-            grad_buf = store(grad_buf, recvs[1], COL_STORE_B_SLOT)
-            if reverse_routes:
-                act_buf = store(act_buf, recvs[2], COL_STORE_F_NEG_SLOT)
-                grad_buf = store(grad_buf, recvs[3], COL_STORE_B_POS_SLOT)
+            # 1. bank arrivals from last tick's ppermute channels — each at
+            # its bank stage (comm_overlap='ring': the recv register IS the
+            # second edge-slot buffer of the double-buffered discipline, so
+            # deferring the edge-slot commit past units that don't touch the
+            # slot removes the data dependency that fences the hop against
+            # this tick's compute). ``bank_stages=None`` — the default and
+            # the scan path — is the all-stage-0 lockstep program,
+            # bit-identical to the pre-overlap executor.
+            stages = (0, 0, 0, 0) if bank_stages is None else tuple(bank_stages)
+
+            def bank_now(k, act_buf, grad_buf):
+                if stages[0] == k:
+                    act_buf = store(act_buf, recvs[0], COL_STORE_F_SLOT)
+                if stages[1] == k:
+                    grad_buf = store(grad_buf, recvs[1], COL_STORE_B_SLOT)
+                if reverse_routes:
+                    if stages[2] == k:
+                        act_buf = store(act_buf, recvs[2],
+                                        COL_STORE_F_NEG_SLOT)
+                    if stages[3] == k:
+                        grad_buf = store(grad_buf, recvs[3],
+                                         COL_STORE_B_POS_SLOT)
+                return act_buf, grad_buf
+
+            act_buf, grad_buf = bank_now(BANK_BEFORE_F, act_buf, grad_buf)
 
             # 2. forward unit
             fv, fm, fslot = row[COL_FWD_V], row[COL_FWD_M], row[COL_FWD_SLOT]
@@ -1267,6 +1356,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                 # same-device hop (vshape's V turning point): the output IS
                 # the next chunk's input — bank it locally, no ring transit
                 act_buf = store(act_buf, fwd_send, COL_FWD_LOCAL_SLOT)
+            act_buf, grad_buf = bank_now(BANK_BEFORE_B, act_buf, grad_buf)
 
             # 3. backward unit (rematerializing)
             bv, bm = row[COL_BWD_V], row[COL_BWD_M]
@@ -1298,6 +1388,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                         know=_concrete_know(ccol(COL_BWD_M)))
                 if reverse_routes:
                     grad_buf = store(grad_buf, bwd_send, COL_BWD_LOCAL_SLOT)
+                act_buf, grad_buf = bank_now(BANK_BEFORE_W, act_buf,
+                                             grad_buf)
 
                 wv, wm = row[COL_W_V], row[COL_W_M]
 
@@ -1364,6 +1456,7 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     else:
                         g_layers, g_embed, g_head = w_out
 
+                act_buf, grad_buf = bank_now(BANK_END, act_buf, grad_buf)
                 return (act_buf, grad_buf, res_bufs,
                         transfers(fwd_send, bwd_send, next_concrete),
                         g_layers, g_embed, g_head, loss_acc) + (
@@ -1522,6 +1615,10 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                     g_layers, g_embed, g_head, loss_acc = b_out
             if reverse_routes:
                 grad_buf = store(grad_buf, bwd_send, COL_BWD_LOCAL_SLOT)
+            # non-split: no W unit, so the BEFORE_W and END bank points
+            # coincide here (both after B, before the hops)
+            act_buf, grad_buf = bank_now(BANK_BEFORE_W, act_buf, grad_buf)
+            act_buf, grad_buf = bank_now(BANK_END, act_buf, grad_buf)
 
             # 4. ring transfer: activations +1, gradients -1 (ICI hops);
             # vshape placements add the two reverse channels
@@ -1546,7 +1643,8 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             # phase-compressed: one specialized scan body per unique row
             # pattern, each phase driven as a lax.scan over its real rows
             carry = _phase_compressed_ticks(tick, carry0, table, phases,
-                                            telemetry=telemetry)
+                                            telemetry=telemetry,
+                                            bank_stages=bank_stages_tab)
         elif unroll_ticks:
             # straight-line tick program: the Python loop IS the schedule,
             # each tick specialized against its concrete table row block
@@ -1561,9 +1659,11 @@ def make_pipeline_grad_fn(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
             end_row = np.full_like(cs.table[0], -1)
             for t in range(n_rows):
                 nxt = cs.table[t + 1] if t + 1 < n_rows else end_row
+                bs = (None if bank_stages_tab is None
+                      else tuple(int(v) for v in bank_stages_tab[t]))
                 with jax.named_scope(f"pp/tick{t:03d}"):
                     carry, _ = tick(carry, table[t], concrete=cs.table[t],
-                                    next_concrete=nxt)
+                                    next_concrete=nxt, bank_stages=bs)
                 if telemetry is not None:
                     telemetry.emit(_tm.TICK, t, _tm.probe_of(carry))
         else:
@@ -1723,6 +1823,7 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
                        unroll_ticks=None,
                        telemetry=None,
                        dynamics=None,
+                       comm_overlap: str = "none",
                        ) -> Callable[[Pytree, jax.Array, jax.Array],
                                      Tuple[jax.Array, Pytree]]:
     """Jitted ``(params, tokens, targets) -> (loss, grads)`` pipeline step.
@@ -1762,12 +1863,17 @@ def make_pipeline_step(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig,
     per-microbatch squared grad norms feeding the gradient-noise-scale
     estimator (see :func:`make_pipeline_grad_fn`; falsy compiles a
     byte-identical program without the accumulator).
+
+    ``comm_overlap`` (``"none"``/``"ring"``/``"auto"``) selects the
+    double-buffered ring-hop discipline — bit-identical outputs, hops
+    overlapped with the next tick's F/B compute (see
+    :func:`make_pipeline_grad_fn`).
     """
     return jax.jit(make_pipeline_grad_fn(
         cfg, mesh, sched, force_tick_executor=force_tick_executor, moe=moe,
         sp_attn_impl=sp_attn_impl, tp_vocab_parallel=tp_vocab_parallel,
         fsdp=fsdp, remat_backward=remat_backward, unroll_ticks=unroll_ticks,
-        telemetry=telemetry, dynamics=dynamics))
+        telemetry=telemetry, dynamics=dynamics, comm_overlap=comm_overlap))
 
 
 def aot_memory_analysis(step, *args) -> Dict[str, Any]:

@@ -36,6 +36,15 @@ Sampling semantics, cache layout and the per-layer math are shared with
 :mod:`..models.generate` (``layers_with_cache`` / ``sample_logits``), so
 pipelined greedy decode emits exactly the single-device tokens
 (tests/test_pipelined_decode.py).
+
+The whole-prompt prefill pass runs with ``prefill=True`` — offset is
+statically zero and every stage's cache is fresh, so the blocks route
+attention through the Pallas flash kernel under the training path's
+``cfg.flash_for`` fallback discipline (``ops.pallas_attention``); decode
+ticks (s=1, traced offsets) and the serving engine's chunked prefill
+stay on the cached dense path. ``return_logprobs`` likewise reuses the
+training loss's kernel dispatch (``cfg.use_fused_xent`` ->
+``ops.pallas_xent``) for the emitted tokens' log-probabilities.
 """
 
 from __future__ import annotations
@@ -58,7 +67,8 @@ from .pipeline import (_check_tp_divisibility, _dense_layer_specs,
 
 def _slot_cache_apply(cfg: ModelConfig, layers_d, h, kc, vc, g, n_rows: int,
                       offset, s: int, *, tp_axis: Optional[str] = None,
-                      tp_size: int = 1, live_rows=None):
+                      tp_size: int = 1, live_rows=None,
+                      prefill: bool = False):
     """One stage's layer slice on ``h`` [n_rows, s, dim] for slot/stream
     ``g``: slice that slot's cache rows (``g*n_rows .. (g+1)*n_rows``),
     run the blocks, write the new k/v back.
@@ -68,12 +78,20 @@ def _slot_cache_apply(cfg: ModelConfig, layers_d, h, kc, vc, g, n_rows: int,
     keep their previous k/v bit-for-bit, so completed requests stop
     mutating state without changing any shape. Shared by the static
     round-robin decoder below and the continuous-batching serving
-    executor (:mod:`..serving.engine`)."""
+    executor (:mod:`..serving.engine`).
+
+    ``prefill=True`` marks statically-zero-offset fresh-cache calls
+    (the round-robin decoder's whole-prompt prefill) flash-eligible —
+    the blocks then route attention through the Pallas kernel under the
+    training path's ``cfg.flash_for`` fallback discipline. The serving
+    engine's chunked prefill consumes TRACED offsets and must keep the
+    default dense path (see :func:`..models.generate._layer_step`)."""
     kg = jax.lax.dynamic_slice_in_dim(kc, g * n_rows, n_rows, axis=1)
     vg = jax.lax.dynamic_slice_in_dim(vc, g * n_rows, n_rows, axis=1)
     rope = rope_slice_at(cfg, kc.shape[2], offset, s)
     h, (kg2, vg2) = layers_with_cache(cfg, layers_d, h, kg, vg, offset, rope,
-                                      tp_axis=tp_axis, tp_size=tp_size)
+                                      tp_axis=tp_axis, tp_size=tp_size,
+                                      prefill=prefill)
     if live_rows is not None:
         m = live_rows[None, :, None, None, None]
         kg2 = jnp.where(m, kg2, kg)
@@ -86,7 +104,8 @@ def _slot_cache_apply(cfg: ModelConfig, layers_d, h, kc, vc, g, n_rows: int,
 def _head_token(cfg: ModelConfig, head_c, embed_c, y_last, key, *,
                 temperature: float = 0.0, top_k: Optional[int] = None,
                 top_p: Optional[float] = None, tp_axis: Optional[str] = None,
-                tp_size: int = 1, vocab_parallel: bool = False):
+                tp_size: int = 1, vocab_parallel: bool = False,
+                return_logprobs: bool = False):
     """Next-token ids [B] from the last-position hidden ``y_last``
     [B, 1, dim] — the last-stage head of both decode executors (the
     caller conds on its stage index so other stages skip the vocab
@@ -99,11 +118,23 @@ def _head_token(cfg: ModelConfig, head_c, embed_c, y_last, key, *,
     merges via a [T, B] all_gather of per-shard (max, argmax) pairs.
     First-max-wins on both levels reproduces the global argmax tie-break
     (lowest index) exactly. Sampling keeps the replicated head: top-k /
-    top-p need globally truncated logits."""
+    top-p need globally truncated logits.
+
+    ``return_logprobs`` (replicated head only — the caller disables the
+    vocab-parallel fast path) additionally returns the sampled token's
+    log-probability [B] f32 via :func:`..models.generate.token_logprob`
+    (``cfg.use_fused_xent`` -> the Pallas fused-NLL kernel)."""
     if not vocab_parallel:
         logits = head_apply(cfg, head_c, y_last, embed=embed_c)[:, 0]
-        return sample_logits(key, logits, temperature, top_k,
-                             top_p).astype(jnp.int32)
+        tok = sample_logits(key, logits, temperature, top_k,
+                            top_p).astype(jnp.int32)
+        if return_logprobs:
+            from ..models.generate import token_logprob
+            return tok, token_logprob(cfg, logits, tok)
+        return tok
+    if return_logprobs:
+        raise ValueError("return_logprobs needs the replicated head "
+                         "(full logits); vocab_parallel must be off")
     from ..models.transformer import head_norm_apply
     t = jax.lax.axis_index(tp_axis)
     Vl = cfg.vocab_size // tp_size
@@ -132,9 +163,19 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
                               top_p: Optional[float] = None,
                               max_len: Optional[int] = None,
                               eos_id: Optional[int] = None,
-                              return_lengths: bool = False):
+                              return_lengths: bool = False,
+                              return_logprobs: bool = False):
     """Build a jitted ``(params, prompt[, key]) -> tokens [B, P+N]``
     decoder over ``mesh``'s 'pipe' axis.
+
+    ``return_logprobs=True`` appends the emitted tokens' log-probs
+    [B, N] f32 to the result — computed on the last stage from the same
+    logits each token was sampled from (``cfg.use_fused_xent`` routes
+    the Pallas fused-NLL kernel, the training loss's dispatch), ridden
+    home on the same ring hop as the token, banked next to it on stage
+    0. EOS-frozen rows report 0.0 for forced tokens. Disables the
+    vocab-parallel greedy head (logprobs need full logits). Matches the
+    single-device ``generate(..., return_logprobs=True)`` row for row.
 
     ``eos_id`` makes decoding EOS-aware: once a request emits ``eos_id``
     its stream freezes — subsequent banked tokens are forced to
@@ -192,6 +233,7 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
         need_key = True
     else:
         need_key = False
+    want_lp = return_logprobs
 
     def spmd(layers_stacked, embed, head, prompt, key_data):
         d = jax.lax.axis_index(PIPE_AXIS)
@@ -218,13 +260,15 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
             return jax.tree.map(
                 lambda x: jax.lax.ppermute(x, PIPE_AXIS, perm), tree)
 
-        def stage_apply(h, kc, vc, g, offset, s, live_rows=None):
+        def stage_apply(h, kc, vc, g, offset, s, live_rows=None,
+                        prefill=False):
             """This device's layer slice on [Bg, s, dim] for stream g
             (shared :func:`_slot_cache_apply`; ``live_rows`` masks cache
-            writes of EOS-frozen requests)."""
+            writes of EOS-frozen requests; ``prefill`` flags the
+            statically-zero-offset whole-prompt pass flash-eligible)."""
             return _slot_cache_apply(cfg, layers_d, h, kc, vc, g, Bg,
                                      offset, s, tp_axis=tp_axis, tp_size=T,
-                                     live_rows=live_rows)
+                                     live_rows=live_rows, prefill=prefill)
 
         # ------------------------------------------------------------------
         # prefill: fill-drain over whole prompts, M + D ticks (the +1 tick
@@ -234,6 +278,9 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
         tok_chan = jnp.zeros((Bg,), jnp.int32)
         token_buf = jnp.zeros((M, Bg), jnp.int32)
         out_buf = jnp.zeros((N, M, Bg), jnp.int32)
+        # token logprobs ride/bank exactly like the tokens themselves
+        lp_chan = jnp.zeros((Bg,), jnp.float32) if want_lp else None
+        lp_buf = jnp.zeros((N, M, Bg), jnp.float32) if want_lp else None
         # EOS bookkeeping lives on stage 0 only (it banks every token);
         # stages d > 0 learn liveness from the mask riding the ring. All
         # of it is gated at Python level so the eos_id=None jaxpr is
@@ -242,20 +289,27 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
         done = jnp.zeros((M, Bg), bool) if use_eos else None
 
         vocab_parallel_head = (tp_axis is not None and not need_key
-                               and cfg.vocab_size % T == 0)
+                               and cfg.vocab_size % T == 0 and not want_lp)
 
         def head_sample(y_last, g, e):
             """Last stage only: logits + sample via the shared
             :func:`_head_token` (vocab-parallel greedy under TP); other
-            stages skip the vocab matmul entirely."""
+            stages skip the vocab matmul entirely. With ``want_lp`` the
+            pair (tok, logprob) comes back instead of the bare token."""
             def live():
                 key = (jax.random.fold_in(jax.random.fold_in(base_key, e), g)
                        if need_key else None)
                 return _head_token(cfg, head_c, embed_c, y_last, key,
                                    temperature=temperature, top_k=top_k,
                                    top_p=top_p, tp_axis=tp_axis, tp_size=T,
-                                   vocab_parallel=vocab_parallel_head)
+                                   vocab_parallel=vocab_parallel_head,
+                                   return_logprobs=want_lp)
 
+            if want_lp:
+                return jax.lax.cond(
+                    d == D - 1, live,
+                    lambda: (jnp.zeros((Bg,), jnp.int32),
+                             jnp.zeros((Bg,), jnp.float32)))
             return jax.lax.cond(d == D - 1, live,
                                 lambda: jnp.zeros((Bg,), jnp.int32))
 
@@ -269,6 +323,9 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
                                       token_buf)
                 out_buf = jnp.where(is_d0, out_buf.at[0, wp].set(tok_chan),
                                     out_buf)
+                if want_lp:  # the first token is always genuinely sampled
+                    lp_buf = jnp.where(is_d0, lp_buf.at[0, wp].set(lp_chan),
+                                       lp_buf)
                 if use_eos:  # a prompt may yield EOS as its FIRST token
                     done = jnp.where(is_d0,
                                      done.at[wp].set(tok_chan == eos_id),
@@ -283,16 +340,27 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
                               _embed_at(cfg, embed_c, prompt_g[g],
                                         jnp.int32(0)).astype(h_chan.dtype),
                               h_chan)
-                y, kc, vc = stage_apply(x, kc, vc, g, jnp.int32(0), Pp)
+                y, kc, vc = stage_apply(x, kc, vc, g, jnp.int32(0), Pp,
+                                        prefill=True)
+                if want_lp:
+                    tok, lp = head_sample(y[:, -1:], g, 0)
+                    return (kc, vc), y, tok, lp
                 tok = head_sample(y[:, -1:], g, 0)
                 return (kc, vc), y, tok
 
             def noop(op):
-                return op, jnp.zeros_like(h_chan), jnp.zeros((Bg,), jnp.int32)
+                z = (op, jnp.zeros_like(h_chan), jnp.zeros((Bg,), jnp.int32))
+                return z + (jnp.zeros((Bg,), jnp.float32),) if want_lp else z
 
-            (kc, vc), y, tok = jax.lax.cond(active, unit, noop, (kc, vc))
-            # one ring carries both: h for d < D-1, token for d == D-1
-            h_chan, tok_chan = ring((y, tok))
+            # one ring carries everything: h for d < D-1, token (and its
+            # logprob) for d == D-1
+            if want_lp:
+                (kc, vc), y, tok, lp = jax.lax.cond(active, unit, noop,
+                                                    (kc, vc))
+                h_chan, tok_chan, lp_chan = ring((y, tok, lp))
+            else:
+                (kc, vc), y, tok = jax.lax.cond(active, unit, noop, (kc, vc))
+                h_chan, tok_chan = ring((y, tok))
 
         # ------------------------------------------------------------------
         # decode: lax.scan over M*(N-1) + D round-robin ticks (the last
@@ -302,12 +370,19 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
         h1 = jnp.zeros((Bg, 1, cfg.dim), jnp.dtype(cfg.dtype))
 
         def tick(carry, u):
+            # carry layout: 6 fixed slots, then (done, lives_chan) when
+            # EOS-aware, then (lp_buf, lp_chan) when logprobs ride along
+            h_chan, tok_chan, kc, vc, token_buf, out_buf = carry[:6]
+            i = 6
             if use_eos:
-                (h_chan, tok_chan, kc, vc, token_buf, out_buf, done,
-                 lives_chan) = carry
+                done, lives_chan = carry[i:i + 2]
+                i += 2
             else:
-                h_chan, tok_chan, kc, vc, token_buf, out_buf = carry
                 done = lives_chan = None
+            if want_lp:
+                lp_buf, lp_chan = carry[i:i + 2]
+            else:
+                lp_buf = lp_chan = None
             # bank the arrival from tick u-1 (which left the last stage at
             # entry index (u - D) // M, producing output token index +1)
             wa = u - D
@@ -322,6 +397,13 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
                                   token_buf)
             out_buf = jnp.where(bank, out_buf.at[ia, ga].set(tok_eff),
                                 out_buf)
+            if want_lp:
+                # forced-EOS rows bank 0.0 (not sampled), same rule as the
+                # single-device generate; `done` is still pre-update here
+                lp_eff = (jnp.where(done[ga], 0.0, lp_chan) if use_eos
+                          else lp_chan)
+                lp_buf = jnp.where(bank, lp_buf.at[ia, ga].set(lp_eff),
+                                   lp_buf)
             if use_eos:
                 done = jnp.where(
                     bank, done.at[ga].set(done[ga] | (tok_eff == eos_id)),
@@ -352,39 +434,67 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
                                         pos).astype(h1.dtype),
                               h_chan)
                 y, kc, vc = stage_apply(x, kc, vc, g, pos, 1, live_rows=lives)
+                if want_lp:
+                    tok, lp = head_sample(y, g, e + 1)
+                    return (kc, vc), y, tok, lp
                 tok = head_sample(y, g, e + 1)
                 return (kc, vc), y, tok
 
             def noop(op):
-                return op, jnp.zeros_like(h1), jnp.zeros((Bg,), jnp.int32)
+                z = (op, jnp.zeros_like(h1), jnp.zeros((Bg,), jnp.int32))
+                return z + (jnp.zeros((Bg,), jnp.float32),) if want_lp else z
 
-            (kc, vc), y, tok = jax.lax.cond(active, unit, noop, (kc, vc))
+            if want_lp:
+                (kc, vc), y, tok, lp = jax.lax.cond(active, unit, noop,
+                                                    (kc, vc))
+            else:
+                (kc, vc), y, tok = jax.lax.cond(active, unit, noop, (kc, vc))
+                lp = None
+            payload = [y, tok]
             if use_eos:
-                lives_out = lives & active
-                h_chan, tok_chan, lives_chan = ring((y, tok, lives_out))
-                return (h_chan, tok_chan, kc, vc, token_buf, out_buf, done,
-                        lives_chan), None
-            h_chan, tok_chan = ring((y, tok))
-            return (h_chan, tok_chan, kc, vc, token_buf, out_buf), None
+                payload.append(lives & active)
+            if want_lp:
+                payload.append(lp)
+            ringed = ring(tuple(payload))
+            h_chan, tok_chan = ringed[0], ringed[1]
+            j = 2
+            if use_eos:
+                lives_chan = ringed[j]
+                j += 1
+            if want_lp:
+                lp_chan = ringed[j]
+            out = (h_chan, tok_chan, kc, vc, token_buf, out_buf)
+            if use_eos:
+                out = out + (done, lives_chan)
+            if want_lp:
+                out = out + (lp_buf, lp_chan)
+            return out, None
 
         T_dec = M * (N - 1) + D
         if T_dec > 0 and N > 1:
             carry0 = (h1, tok_chan, kc, vc, token_buf, out_buf)
             if use_eos:
                 carry0 = carry0 + (done, jnp.zeros((Bg,), bool))
+            if want_lp:
+                carry0 = carry0 + (lp_buf, lp_chan)
             carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T_dec))
             token_buf, out_buf = carry[4], carry[5]
+            if want_lp:
+                lp_buf = carry[6 + (2 if use_eos else 0)]
 
         # outputs live on device 0; psum replicates across the pipe ring
         out = jax.lax.psum(jnp.where(d == 0, out_buf, 0), PIPE_AXIS)
         # [N, M, Bg] -> [B, N]
         toks = jnp.moveaxis(out, 0, -1).reshape(B, N)
+        if want_lp:
+            lpo = jax.lax.psum(jnp.where(d == 0, lp_buf, 0.0), PIPE_AXIS)
+            lps = jnp.moveaxis(lpo, 0, -1).reshape(B, N)
         if not use_eos:
-            return toks
+            return (toks, lps) if want_lp else toks
         hit = toks == eos_id
         lengths = jnp.where(hit.any(axis=1), jnp.argmax(hit, axis=1) + 1,
                             N).astype(jnp.int32)
-        return toks, lengths
+        return (toks, lengths, lps) if want_lp else (toks, lengths)
 
     # layers: 'pipe' on the stage dim, plus Megatron 'model' dims when a
     # model axis is present (same stacked-layout specs as the training
@@ -404,11 +514,15 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
         with jax.named_scope("decode/pipeline"):
             res = sharded(stacked, params["embed"], params["head"], prompt,
                           key_data)
-        new = res[0] if eos_id is not None else res
+        # spmd returns toks[, lengths when eos-aware][, logprobs]
+        new = res[0] if (eos_id is not None or want_lp) else res
         toks = jnp.concatenate([prompt, new.astype(prompt.dtype)], axis=1)
+        outs = (toks,)
         if return_lengths:
-            return toks, res[1]
-        return toks
+            outs = outs + (res[1],)
+        if want_lp:
+            outs = outs + (res[-1],)
+        return outs if len(outs) > 1 else toks
 
     def gen(params, prompt, key=None):
         # precondition checks run OUTSIDE jit so violations surface as

@@ -1516,6 +1516,118 @@ def phase_unit_activity(phases: Sequence[Phase]) -> np.ndarray:
                      for p in phases])
 
 
+# Ring channels in the executor's recv-register order: (bank column,
+# buffer kind). The recv register itself is the "second edge-slot buffer"
+# of the double-buffered discipline — an arrival rides it across the tick
+# until its bank stage, so the hop that produced it overlaps compute.
+OVERLAP_CHANNELS: Tuple[Tuple[int, str], ...] = (
+    (COL_STORE_F_SLOT, "act"),
+    (COL_STORE_B_SLOT, "grad"),
+    (COL_STORE_F_NEG_SLOT, "act"),
+    (COL_STORE_B_POS_SLOT, "grad"),
+)
+
+# Bank stages: where within a tick a channel's arrival is committed from
+# its recv register into the edge slot. Stage k means "immediately before
+# unit k" with units ordered F(0), B(1), W(2); stage 3 is end-of-tick
+# (just before the next hops replace the registers). Stage 0 is the
+# lockstep discipline; later stages let the producing ppermute overlap
+# this tick's earlier units.
+BANK_BEFORE_F, BANK_BEFORE_B, BANK_BEFORE_W, BANK_END = 0, 1, 2, 3
+
+
+def overlap_bank_stages(table: np.ndarray) -> np.ndarray:
+    """Latest-safe bank stage per (tick, ring channel): ``[T, 4]`` int8.
+
+    For each tick and each of the four ring channels (order =
+    :data:`OVERLAP_CHANNELS`, matching the executor's recv registers),
+    computes the latest point in the tick at which the arrival can be
+    committed to its edge slot without changing any unit's inputs or the
+    final buffer state — i.e. the earliest same-tick *conflict* with the
+    banked slot, minimized across devices (SPMD: one program, one bank
+    site per channel per tick). Conflicts, per device, against the
+    device's banked slot ``s``:
+
+    - the F unit (stage 0) reads AND writes ``act_buf[COL_FWD_SLOT]``
+      and (vshape routes) writes ``act_buf[COL_FWD_LOCAL_SLOT]``;
+      banking must precede a write so the unit's write lands last
+      (write-last ordering of the lockstep tick is preserved).
+    - the B unit (stage 1) reads ``act_buf[COL_BWD_ASLOT]`` and
+      ``grad_buf[COL_BWD_GSLOT]``, and (vshape) writes
+      ``grad_buf[COL_BWD_LOCAL_SLOT]``.
+    - the W unit (stage 2) reads ``act_buf[COL_W_ASLOT]`` and
+      ``grad_buf[COL_W_GSLOT]``.
+
+    No conflict => stage 3 (end of tick). Banking EARLIER than the
+    returned stage is always lockstep-correct, so the cross-device min is
+    conservative and the staged executor is bit-identical to the lockstep
+    one by construction. This classifier is the single source of truth:
+    the executor banks at these stages, ``analysis.table_check`` verifies
+    the register lifetime under them, and ``analysis.cost_model``'s
+    ``comm_overlap`` mode derives per-tick overlappable hop time from
+    them.
+    """
+    table = np.asarray(table)
+    if table.ndim != 3 or table.shape[2] < N_COLS:
+        raise ScheduleError(
+            f"overlap_bank_stages needs a [T, D, {N_COLS}] training table, "
+            f"got shape {table.shape}")
+    T, D, _ = table.shape
+    out = np.full((T, len(OVERLAP_CHANNELS)), BANK_END, dtype=np.int8)
+    f_on = table[:, :, COL_FWD_M] >= 0
+    b_on = table[:, :, COL_BWD_M] >= 0
+    w_on = table[:, :, COL_W_M] >= 0
+    # (stage, active-mask, slot-column, buffer kind); writes behave like
+    # reads here — both pin the bank before the unit that touches the slot.
+    touches = (
+        (BANK_BEFORE_F, f_on, COL_FWD_SLOT, "act"),
+        (BANK_BEFORE_F, table[:, :, COL_FWD_LOCAL_SLOT] >= 0,
+         COL_FWD_LOCAL_SLOT, "act"),
+        (BANK_BEFORE_B, b_on, COL_BWD_ASLOT, "act"),
+        (BANK_BEFORE_B, b_on, COL_BWD_GSLOT, "grad"),
+        (BANK_BEFORE_B, table[:, :, COL_BWD_LOCAL_SLOT] >= 0,
+         COL_BWD_LOCAL_SLOT, "grad"),
+        (BANK_BEFORE_W, w_on, COL_W_ASLOT, "act"),
+        (BANK_BEFORE_W, w_on, COL_W_GSLOT, "grad"),
+    )
+    for ci, (bank_col, kind) in enumerate(OVERLAP_CHANNELS):
+        slots = table[:, :, bank_col]          # [T, D]; -1 = no bank
+        banked = slots >= 0
+        if not banked.any():
+            continue
+        stage = np.full((T, D), BANK_END, dtype=np.int8)
+        for st, on, slot_col, k in touches:
+            if k != kind:
+                continue
+            hit = banked & on & (table[:, :, slot_col] == slots)
+            stage = np.where(hit, np.minimum(stage, st), stage)
+        stage = np.where(banked, stage, BANK_END)
+        out[:, ci] = stage.min(axis=1)
+    # Two channels of the same buffer landing in the SAME slot on the same
+    # tick must keep their lockstep write order; forcing equal stages makes
+    # the in-stage channel order (= lockstep order) decide.
+    for i, j in ((0, 2), (1, 3)):
+        si = table[:, :, OVERLAP_CHANNELS[i][0]]
+        sj = table[:, :, OVERLAP_CHANNELS[j][0]]
+        clash = ((si >= 0) & (sj >= 0) & (si == sj)).any(axis=1)
+        if clash.any():
+            m = np.minimum(out[:, i], out[:, j])
+            out[:, i] = np.where(clash, m, out[:, i])
+            out[:, j] = np.where(clash, m, out[:, j])
+    return out
+
+
+def phase_bank_stages(phase: Phase,
+                      bank_stages: np.ndarray) -> np.ndarray:
+    """Fold a table-wide ``[T, 4]`` bank-stage map onto one phase's period
+    positions: ``[period, 4]``, min across repetitions AND across every
+    tick the table maps to the position (conservative => lockstep-correct
+    for all of them). The phase executor compiles one body per (pattern,
+    successor-mask, bank-stage) triple and banks at these stages."""
+    rows = bank_stages[phase.start:phase.start + phase.length]
+    return rows.reshape(phase.reps, phase.period, -1).min(axis=0)
+
+
 # ---------------------------------------------------------------------------
 # Bubble analytics
 # ---------------------------------------------------------------------------

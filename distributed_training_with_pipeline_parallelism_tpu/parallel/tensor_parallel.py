@@ -9,6 +9,16 @@ contrast with the pipeline executor, which is manual SPMD because schedules
 need explicit control.
 
 Composes with data parallelism (add a 'data' axis and shard the batch).
+
+The manual-SPMD side (the pipeline executor's in-``shard_map`` TP) adds
+the **collective-matmul wrappers** here: :func:`tp_all_gather_matmul` and
+:func:`tp_matmul_reduce_scatter` are the canonical fused TP-boundary
+matmuls behind ``ModelConfig.tp_overlap`` — ``"ring"`` dispatches to the
+overlapped ring forms in :mod:`..ops.collectives`, ``"none"`` is the
+unfused gather-then-matmul reference. They are also the *only* legal call
+sites of bare ``jax.lax.all_gather`` / ``jax.lax.psum_scatter`` in this
+module (``scripts/repo_lint.py`` enforces it), so every TP boundary
+collective stays routed through one overlap-dispatchable seam.
 """
 
 from __future__ import annotations
@@ -26,6 +36,70 @@ from .mesh import DATA_AXIS, MODEL_AXIS
 TP_AXIS = MODEL_AXIS  # one axis-name constant: pipeline TP shards onto it
 
 Pytree = Any
+
+
+# ---------------------------------------------------------------------------
+# Collective-matmul wrappers (the tp_overlap seam)
+# ---------------------------------------------------------------------------
+
+
+def resolve_tp_overlap(mode: str, axis_size: int, seq_len: int) -> str:
+    """Resolve a ``ModelConfig.tp_overlap`` knob to a concrete mode.
+
+    ``"none"`` keeps the unfused Megatron path bitwise unchanged.
+    ``"ring"`` demands the fused forms (raises when the sequence cannot be
+    chunked ``axis_size`` ways). ``"auto"`` picks ``"ring"`` on TPU when
+    the shapes divide — the ring decomposition only *wins* where the hop
+    rides a real ICI link — and falls back to ``"none"`` on the CPU proxy
+    (where ppermute is a copy and the unfused collectives are cheaper to
+    compile).
+    """
+    if mode not in ("none", "ring", "auto"):
+        raise ValueError(f"tp_overlap must be 'none', 'ring' or 'auto', "
+                         f"got {mode!r}")
+    divisible = axis_size > 1 and seq_len % axis_size == 0
+    if mode == "ring":
+        if not divisible:
+            raise ValueError(
+                f"tp_overlap='ring' needs seq_len ({seq_len}) divisible by "
+                f"the model-axis size ({axis_size}) > 1")
+        return "ring"
+    if mode == "auto":
+        return ("ring" if divisible and jax.default_backend() == "tpu"
+                else "none")
+    return "none"
+
+
+def tp_all_gather_matmul(x_loc: jax.Array, w: jax.Array, axis_name: str,
+                         axis_size: int, mode: str = "none") -> jax.Array:
+    """TP up-projection over a sequence-sharded input:
+    ``all_gather(x, seq) @ w`` -> full-seq column-sharded ``[B, S, F/T]``.
+
+    ``mode="ring"`` overlaps the gather into the matmul
+    (:func:`ops.collectives.all_gather_matmul`, bit-identical);
+    ``"none"`` is the unfused reference and the wrappers' one legal bare
+    ``jax.lax.all_gather`` site."""
+    if mode == "ring":
+        from ..ops.collectives import all_gather_matmul
+        return all_gather_matmul(x_loc, w, axis_name, axis_size)
+    return jax.lax.all_gather(x_loc, axis_name, axis=1, tiled=True) @ w
+
+
+def tp_matmul_reduce_scatter(z: jax.Array, w: jax.Array, axis_name: str,
+                             axis_size: int, mode: str = "none") -> jax.Array:
+    """TP down-projection completing into a sequence-sharded output:
+    ``reduce_scatter(z @ w, seq)`` -> this rank's chunk ``[B, S/T, d]``.
+
+    ``mode="ring"`` overlaps the scatter into the matmul
+    (:func:`ops.collectives.matmul_reduce_scatter`; ring summation order,
+    so parity with the unfused form is numerical); ``"none"`` is the
+    unfused reference and the wrappers' one legal bare
+    ``jax.lax.psum_scatter`` site."""
+    if mode == "ring":
+        from ..ops.collectives import matmul_reduce_scatter
+        return matmul_reduce_scatter(z, w, axis_name, axis_size)
+    return jax.lax.psum_scatter(z @ w, axis_name, scatter_dimension=1,
+                                tiled=True)
 
 
 def make_tp_mesh(n_model: int, n_data: int = 1, devices=None) -> Mesh:

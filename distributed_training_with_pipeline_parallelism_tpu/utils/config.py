@@ -104,6 +104,16 @@ class ModelConfig:
     head_dim_override: Optional[int] = None
     mlp_act: str = "silu"
     embed_scale: bool = False
+    # TP comm/compute overlap for the manual-SPMD MLP under a 'model' axis:
+    # "none" (default) keeps the unfused Megatron block bitwise unchanged;
+    # "ring" routes the MLP boundary through the collective-matmul forms
+    # (ops.collectives.all_gather_matmul / matmul_reduce_scatter), which
+    # overlap the TP all-gather with the up-projection and the
+    # reduce-scatter with the down-projection (requires seq divisible by
+    # the model-axis size); "auto" picks ring on TPU where the shapes
+    # divide and falls back to the unfused path on the CPU proxy
+    # (parallel.tensor_parallel.resolve_tp_overlap).
+    tp_overlap: str = "none"
 
     def __post_init__(self):
         if self.dim % self.n_heads != 0:
@@ -139,6 +149,9 @@ class ModelConfig:
                                  f"be >= 1")
         if not 0.0 <= self.dropout < 1.0:
             raise ValueError(f"dropout={self.dropout} must be in [0, 1)")
+        if self.tp_overlap not in ("none", "ring", "auto"):
+            raise ValueError(f"tp_overlap={self.tp_overlap!r} must be "
+                             f"'none', 'ring', or 'auto'")
         if self.use_flash_attention not in (True, False, "auto"):
             raise ValueError(
                 f"use_flash_attention={self.use_flash_attention!r} must be "

@@ -402,8 +402,19 @@ def critical_path(telemetry: PipelineTelemetry) -> Dict[str, Any]:
     flight (some channel banks a store next tick), and *bubble* when the
     tick does neither. Returns aggregate seconds, the per-tick
     classification, and per-device straggler time — "which stage is the
-    step waiting on" as a number."""
-    from ..parallel.schedules import table_unit_activity
+    step waiting on" as a number.
+
+    Training-table comm is additionally attributed overlap-aware: each
+    hop landing at tick ``t`` is classified by its verified bank stage
+    (:func:`parallel.schedules.overlap_bank_stages`) into
+    ``hops_exposed`` (banks before the first unit — serial even under
+    ``comm_overlap="ring"``) vs ``hops_overlappable`` (hides behind the
+    units that run before its bank point); the aggregate
+    ``exposed_hop_ticks`` / ``overlappable_hop_ticks`` are the same
+    counts the cost model's ``comm_overlap`` mode prices."""
+    from ..parallel.schedules import (BANK_BEFORE_F, N_COLS,
+                                      overlap_bank_stages,
+                                      table_unit_activity)
     if telemetry.table is None:
         raise ValueError("no tick table attached")
     table = telemetry.table
@@ -412,8 +423,16 @@ def critical_path(telemetry: PipelineTelemetry) -> Dict[str, Any]:
     t0, dur = _tick_times(telemetry)
     weights = np.array([1.0, 2.0, 1.0, 0.0])
     work = activity.astype(np.float64) @ weights  # [T, D]
-    store_cols = [col for _, col, _ in _store_channels()]
+    channels = _store_channels()
+    store_cols = [col for _, col, _ in channels]
+    # overlap-aware hop attribution: classify each landing hop by its
+    # verified bank stage (exposed = fences the tick's first unit even
+    # under comm_overlap="ring"; overlappable = hides behind the units
+    # before its bank point). Forward-only tables have no stage map.
+    bank_st = (overlap_bank_stages(table) if table.shape[2] >= N_COLS
+               else None)
     agg = {"compute": 0.0, "comm": 0.0, "bubble": 0.0}
+    exposed_hops = overlappable_hops = 0
     straggler_s = np.zeros(D)
     per_tick: List[Dict[str, Any]] = []
     for t in range(T):
@@ -428,8 +447,22 @@ def critical_path(telemetry: PipelineTelemetry) -> Dict[str, Any]:
         else:
             cls, straggler = "bubble", None
         agg[cls] += dur[t]
-        per_tick.append({"tick": t, "class": cls, "straggler": straggler,
-                         "duration_s": float(dur[t])})
+        row: Dict[str, Any] = {"tick": t, "class": cls,
+                               "straggler": straggler,
+                               "duration_s": float(dur[t])}
+        if bank_st is not None and t >= 1:
+            n_exp = n_lap = 0
+            for ci, (_, col, _) in enumerate(channels):
+                if (table[t][:, col] >= 0).any():
+                    if int(bank_st[t, ci]) == BANK_BEFORE_F:
+                        n_exp += 1
+                    else:
+                        n_lap += 1
+            if n_exp or n_lap:
+                row["hops_exposed"], row["hops_overlappable"] = n_exp, n_lap
+            exposed_hops += n_exp
+            overlappable_hops += n_lap
+        per_tick.append(row)
     sd = int(straggler_s.argmax())
     return {
         "n_ticks": T,
@@ -437,6 +470,8 @@ def critical_path(telemetry: PipelineTelemetry) -> Dict[str, Any]:
         "compute_s": float(agg["compute"]),
         "comm_s": float(agg["comm"]),
         "bubble_s": float(agg["bubble"]),
+        "exposed_hop_ticks": exposed_hops,
+        "overlappable_hop_ticks": overlappable_hops,
         "straggler_s_per_device": [float(x) for x in straggler_s],
         "straggler_device": sd,
         "straggler_stage": f"device {sd}",
@@ -455,7 +490,11 @@ def perfetto_trace(telemetry: PipelineTelemetry,
     ``B v1 m2`` / ``W m0`` / ``idle``, categorized by kind — and one
     ``"s"``→``"f"`` flow pair per ring-hop store (cat ``ppermute``,
     anchored mid-slice on the sending and receiving ticks) so arrows in
-    the UI show exactly the hops the table predicts. When the telemetry
+    the UI show exactly the hops the table predicts; each flow's args
+    carry its verified ``bank_stage`` and an ``overlap`` tag
+    (``exposed`` = fences the landing tick's first unit,
+    ``overlappable`` = hides behind compute under
+    ``comm_overlap="ring"``). When the telemetry
     carries live watermark samples, each device additionally gets a
     ``"C"`` counter track (``HBM bytes_in_use``) sampled at step
     boundaries, drawn right next to the F/B/W slices. ``serving_events``:
@@ -504,21 +543,38 @@ def perfetto_trace(telemetry: PipelineTelemetry,
                 events.append({"ph": "X", "name": "idle", "cat": "idle",
                                "pid": 0, "tid": d, "ts": ts, "dur": width,
                                "args": {"tick": t}})
+    # flow args carry the hop's verified bank stage so overlapped comm
+    # reads directly off the arrows: stage 0 arrivals fence the landing
+    # tick's first unit (exposed), later stages ride under its compute
+    from ..parallel.schedules import (BANK_BEFORE_F, N_COLS,
+                                      overlap_bank_stages)
+    bank_st = (overlap_bank_stages(table) if table.shape[2] >= N_COLS
+               else None)
     flow_id = 0
+    n_overlappable = 0
     for t in range(1, T):
-        for name, col, offset in _store_channels():
+        for ci, (name, col, offset) in enumerate(_store_channels()):
+            stage = None if bank_st is None else int(bank_st[t, ci])
+            overlapped = stage is not None and stage > BANK_BEFORE_F
             for d in range(D):
                 if table[t, d, col] >= 0:
                     flow_id += 1
+                    n_overlappable += int(overlapped)
                     sender = (d - offset) % D
+                    args = ({} if stage is None else
+                            {"bank_stage": stage,
+                             "overlap": ("overlappable" if overlapped
+                                         else "exposed")})
                     events.append({
                         "ph": "s", "id": flow_id, "name": name,
                         "cat": "ppermute", "pid": 0, "tid": sender,
-                        "ts": (t0[t - 1] + 0.5 * dur[t - 1]) * us})
+                        "ts": (t0[t - 1] + 0.5 * dur[t - 1]) * us,
+                        "args": args})
                     events.append({
                         "ph": "f", "bp": "e", "id": flow_id, "name": name,
                         "cat": "ppermute", "pid": 0, "tid": d,
-                        "ts": (t0[t] + 0.5 * dur[t]) * us})
+                        "ts": (t0[t] + 0.5 * dur[t]) * us,
+                        "args": args})
     # live HBM counter track: one "C" event per (boundary sample, device),
     # on the same clock as the stamps so the sawtooth lines up with ticks
     n_counters = 0
@@ -547,6 +603,7 @@ def perfetto_trace(telemetry: PipelineTelemetry,
         "displayTimeUnit": "ms",
         "otherData": {"executor": telemetry.executor, "n_devices": D,
                       "n_ticks": T, "n_flows": flow_id,
+                      "n_overlappable_flows": n_overlappable,
                       "n_memory_counters": n_counters,
                       "n_dynamics_counters": n_dyn},
     }
@@ -1224,7 +1281,8 @@ def validate_report(manifest: Dict[str, Any]) -> None:
         pred = cm.get("predicted")
         if not isinstance(pred, dict):
             fail("cost_model.predicted must be a dict")
-        for key in ("step_s", "bubble_table_exact", "bubble_closed_form"):
+        for key in ("step_s", "step_s_comm_overlap", "bubble_table_exact",
+                    "bubble_closed_form"):
             if not isinstance(pred.get(key), (int, float)):
                 fail(f"cost_model.predicted.{key} must be a number")
         comm = cm.get("comm")

@@ -22,7 +22,12 @@ the same (name, backend, schedule) group:
   (p99 TTFT in ticks at the sweep's reference load) rises by more than
   the threshold — the serving SLO guard: a scheduler change that moves
   the knee left or inflates uncontended tail latency fails here before
-  a deployment notices.
+  a deployment notices,
+- ``overlap_tokens_per_sec`` (bench's ``overlap_on`` pair row — the
+  double-buffered ring executor, docs/performance.md "Comm/compute
+  overlap") drops by more than the threshold: a change that silently
+  re-serializes the early-issued hops fails here. CPU-proxy runs stay
+  warn-only like every wall-clock gate below.
 
 Model-health metrics from the report's ``dynamics`` section (or sweep
 gauges) — ``grad_norm_final`` and ``gns`` — get WARN-only two-sided
@@ -105,6 +110,7 @@ def extract_metrics(manifest) -> dict:
             "n_skipped_attributed": None,
             "max_sustainable_load": None,
             "serve_ttft_p99_ref": None,
+            "overlap_tokens_per_sec": None,
         }
     gauges = manifest.get("gauges") or {}
     cm = manifest.get("cost_model")
@@ -146,6 +152,11 @@ def extract_metrics(manifest) -> dict:
     sl = manifest.get("serving_load")
     max_sustainable = _num(_get(sl, "knee", "max_sustainable_load"))
     ttft_ref = _num(_get(sl, "reference", "ttft_p99_ticks"))
+    # comm/compute overlap pair (bench.py): the overlap-on throughput is
+    # guarded like the headline; on a cpu-proxy backend all throughput
+    # gates are already warn-only, so the jittery serialized-tick number
+    # never hard-fails the sentinel
+    overlap_tps = _num(gauges.get("overlap_on_tokens_per_sec"))
     return {
         "t": time.time(),
         "name": _get(manifest, "meta", "name") or "unknown",
@@ -168,6 +179,7 @@ def extract_metrics(manifest) -> dict:
                                  else None),
         "max_sustainable_load": max_sustainable,
         "serve_ttft_p99_ref": ttft_ref,
+        "overlap_tokens_per_sec": overlap_tps,
     }
 
 
@@ -215,7 +227,8 @@ def check(row, history, threshold, window) -> list:
                            ("bubble", "up"), ("peak_temp_bytes", "up"),
                            ("peak_live_bytes", "up"),
                            ("max_sustainable_load", "down"),
-                           ("serve_ttft_p99_ref", "up")):
+                           ("serve_ttft_p99_ref", "up"),
+                           ("overlap_tokens_per_sec", "down")):
         val = row.get(key)
         prior = [r[key] for r in group
                  if isinstance(r.get(key), (int, float))

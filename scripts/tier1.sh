@@ -100,6 +100,27 @@ if ! timeout -k 10 60 \
   exit 1
 fi
 echo "SERVE_LOAD=ok"
+# Comm/compute overlap leg (own budget): the overlap grid check prices
+# every registered schedule in the cost model's comm_overlap mode and
+# pins the step_s_overlapped <= step_s_comm_overlap <= step_s sandwich
+# plus the two-buffer hop census; the parity tests then witness the
+# double-buffered executors bit-identical to lockstep and the ring
+# collective matmuls numerically equal to the unfused Megatron path
+# (docs/performance.md "Comm/compute overlap"). Runs ahead of the main
+# suite so an overlap regression fails even when the budget kills
+# pytest early.
+if ! timeout -k 10 120 \
+    python scripts/check.py --overlap; then
+  echo "OVERLAP=fail"
+  exit 1
+fi
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_overlap.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+  echo "OVERLAP=fail"
+  exit 1
+fi
+echo "OVERLAP=ok"
 # Resilience liveness last (own budget): a run killed mid-checkpoint-flush
 # must resume from the last committed step and finish bitwise equal to the
 # uninterrupted run, with anomaly/preemption counters in a validated

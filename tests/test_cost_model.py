@@ -165,7 +165,8 @@ def test_validate_report_rejects_bad_cost_model():
         validate_report(bad)
     bad = dict(manifest, cost_model={
         "schedule": "GPipe", "hardware": {"name": "x", "peak_flops": 1.0},
-        "predicted": {"step_s": 1.0, "bubble_table_exact": 0.1,
+        "predicted": {"step_s": 1.0, "step_s_comm_overlap": 0.9,
+                      "bubble_table_exact": 0.1,
                       "bubble_closed_form": 0.1},
         "comm": {"hops": "many"}})
     with pytest.raises(ValueError, match="hops"):
