@@ -223,6 +223,8 @@ def _cost_model(cfg, batch_size, seq_length, n_pipe, headline,
     """Roofline section for the headline config (analysis.cost_model):
     predicted vs measured step time, bubble fractions, MFU/HFU — attached
     to the RunReport manifest and consumed by scripts/regress.py."""
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.calibration import (
+        maybe_load_default_corrections)
     from distributed_training_with_pipeline_parallelism_tpu.analysis.cost_model import (
         cost_model_section)
     from distributed_training_with_pipeline_parallelism_tpu.parallel.schedules import (
@@ -230,7 +232,8 @@ def _cost_model(cfg, batch_size, seq_length, n_pipe, headline,
     cs = compile_schedule("GPipe", n_pipe, 1, n_microbatches)
     return cost_model_section(
         cs, cfg, batch_size=batch_size, seq_length=seq_length,
-        measured_step_s=headline["elapsed_s"] / max(num_iterations, 1))
+        measured_step_s=headline["elapsed_s"] / max(num_iterations, 1),
+        correction=maybe_load_default_corrections())
 
 
 def _memory_model(cfg, batch_size, seq_length, n_pipe, n_microbatches=4,
@@ -286,6 +289,25 @@ def _result(headline, extra, n_pipe) -> dict:
     cm = extra.get("cost_model")
     if isinstance(cm, dict) and "schedule" in cm:  # not an error stub
         report.attach_cost_model(cm)
+        # predicted-vs-measured as first-class gauges + a calibration
+        # section, so scripts/regress.py guards model error the same way
+        # it guards throughput (docs/observability.md §9)
+        report.gauge("predicted_step_s", cm["predicted"]["step_s"])
+        measured = cm.get("measured") or {}
+        # ...and as first-class headline-row columns in the printed JSON
+        headline["predicted_step_s"] = cm["predicted"]["step_s"]
+        headline["rel_err"] = measured.get("rel_err")
+        if measured.get("rel_err") is not None:
+            report.gauge("rel_err", measured["rel_err"])
+        if measured.get("rel_err_corrected") is not None:
+            report.gauge("rel_err_corrected", measured["rel_err_corrected"])
+        from distributed_training_with_pipeline_parallelism_tpu.analysis.calibration import (
+            calibration_section_from_cost_model, maybe_load_default_corrections)
+        cal_section = calibration_section_from_cost_model(
+            cm, backend=str(extra.get("backend", "unknown")), name="bench",
+            correction=maybe_load_default_corrections())
+        if cal_section is not None:
+            report.attach_calibration(cal_section)
     mem = extra.get("memory")
     if isinstance(mem, dict) and "analytic" in mem:  # not an error stub
         report.attach_memory(mem)

@@ -14,7 +14,8 @@ distributed_training_with_pipeline_parallelism_tpu.analysis``):
   verifier's prediction, dtype drift.
 - :mod:`.repo_lint` — ast rules: no host calls in tick/scan bodies,
   lazy-export discipline in ``__init__.py``, no bare ``jax.jit`` without
-  a named scope in ``parallel/``.
+  a named scope in ``parallel/``, no raw host-clock step timing outside
+  the sanctioned timing surfaces (``raw-step-timing``).
 - :mod:`.cost_model` — analytical roofline accounting over compiled tick
   tables (FLOPs per F/B/W unit, bytes per ring hop, predicted step time
   under a :class:`~.cost_model.HardwareSpec`, table-exact/closed-form
@@ -27,6 +28,13 @@ distributed_training_with_pipeline_parallelism_tpu.analysis``):
   ``memory_stats()`` watermarks) and reconciled; source of the
   sweep/bench OOM preflight and the byte-denominated search budgets
   (docs/observability.md "Memory observatory").
+- :mod:`.calibration` — the measured-probe leg that closes the loop on
+  both models: a deterministic micro-probe harness
+  (``scripts/probe.py``), the predicted-vs-measured ledger
+  (``results/calibration.jsonl``, per-axis signed relative error grouped
+  by backend/schedule family/backward policy), and least-squares
+  per-hardware correction factors the cost model applies when available
+  (docs/observability.md "Calibration observatory").
 - :mod:`.schedule_search` — the certifying schedule compiler: seeded,
   deterministic search over per-device action orders whose objective is
   the cost model's predicted step time and whose hard constraints are
@@ -136,6 +144,27 @@ _LAZY = {
     "compiled_memory_section": ("memory_model", "compiled_memory_section"),
     "reconcile_memory": ("memory_model", "reconcile_memory"),
     "oom_preflight": ("memory_model", "oom_preflight"),
+    "comm_overlap_step_time": ("cost_model", "comm_overlap_step_time"),
+    "predicted_tick_seconds": ("cost_model", "predicted_tick_seconds"),
+    "memory_probe_axes": ("memory_model", "memory_probe_axes"),
+    "CalibrationError": ("calibration", "CalibrationError"),
+    "ProbeSpec": ("calibration", "ProbeSpec"),
+    "probe_grid": ("calibration", "probe_grid"),
+    "run_probe": ("calibration", "run_probe"),
+    "reprice_row": ("calibration", "reprice_row"),
+    "schedule_family": ("calibration", "schedule_family"),
+    "load_ledger": ("calibration", "load_ledger"),
+    "append_ledger_rows": ("calibration", "append_ledger_rows"),
+    "group_errors": ("calibration", "group_errors"),
+    "CorrectionFactors": ("calibration", "CorrectionFactors"),
+    "fit_corrections": ("calibration", "fit_corrections"),
+    "correction_artifact": ("calibration", "correction_artifact"),
+    "load_correction_artifact": ("calibration", "load_correction_artifact"),
+    "maybe_load_default_corrections": ("calibration",
+                                       "maybe_load_default_corrections"),
+    "calibration_section": ("calibration", "calibration_section"),
+    "calibration_section_from_cost_model":
+        ("calibration", "calibration_section_from_cost_model"),
     "SearchSpec": ("schedule_search", "SearchSpec"),
     "SearchResult": ("schedule_search", "SearchResult"),
     "search_schedule": ("schedule_search", "search_schedule"),

@@ -393,10 +393,124 @@ def run_search(out_path: Optional[str] = None, *, seed: int = 0,
     return out
 
 
+def run_calibration_checks() -> Dict[str, Any]:
+    """The ``--calibration`` pass: host-side structural checks over the
+    calibration observatory (pure numpy — no backend, no measured
+    probes; the measured leg is ``scripts/probe.py``):
+
+    - the probe grid is seeded-deterministic and spans the contract
+      (>= 8 configs, >= 3 schedule families, all three backward
+      policies, both comm_overlap modes);
+    - the least-squares correction fit recovers known synthetic
+      efficiencies to float64 accuracy;
+    - the correction artifact byte-roundtrips and rejects tampering;
+    - a corrected ``cost_model_section`` preserves the overlap sandwich
+      (overlapped <= comm_overlap <= serial) — positive de-rating can
+      reorder nothing;
+    - malformed ledger rows are rejected with located errors.
+    """
+    from ..parallel.schedules import compile_schedule
+    from ..utils.config import ModelConfig
+    from . import calibration as cal
+    from .cost_model import cost_model_section
+
+    cases: List[Dict[str, Any]] = []
+
+    def case(name: str, ok: bool, **extra: Any) -> None:
+        cases.append({"case": name, "ok": bool(ok), **extra})
+
+    g0, g1 = cal.probe_grid(seed=0), cal.probe_grid(seed=0)
+    case("grid_deterministic", g0 == g1)
+    families = {cal.schedule_family(s.schedule) for s in g0}
+    policies = {cal._policy_of(s.schedule, s.remat_backward, s.n_devices)
+                for s in g0}
+    overlaps = {s.comm_overlap for s in g0}
+    case("grid_coverage",
+         len(g0) >= 8 and len(families) >= 3
+         and policies == {"stored", "remat", "split"}
+         and overlaps == {"none", "ring"},
+         n_configs=len(g0), families=sorted(families),
+         policies=sorted(policies), overlaps=sorted(overlaps))
+
+    # synthetic fit: measured = compute/e_f + comm/e_b must be recovered
+    e_f, e_b = 0.02, 0.5
+    rows = []
+    for i, (c, k) in enumerate(((1e-3, 1e-4), (2e-3, 5e-4), (3e-3, 2e-4),
+                                (5e-3, 8e-4))):
+        rows.append({
+            "schema_version": cal.CALIBRATION_SCHEMA_VERSION,
+            "kind": cal.LEDGER_KIND, "source": "synthetic", "t": 0.0,
+            "name": f"syn{i}", "backend": "cpu", "hardware": "syn_hw",
+            "cpu_proxy": True, "schedule": "GPipe",
+            "schedule_family": "GPipe", "backward_policy": "remat",
+            "comm_overlap": "none", "n_devices": 2, "n_virtual": 1,
+            "n_microbatches": 4, "batch_size": 8, "seq_length": 16,
+            "predicted": {"compute_s": c, "comm_s": k,
+                          "step_s": c + k},
+            "measured": {"step_s": c / e_f + k / e_b},
+            "rel_err": None, "corrected": None,
+        })
+    fit = cal.fit_correction(rows, "syn_hw")
+    case("fit_recovers_synthetic",
+         fit is not None
+         and abs(fit.flops_efficiency - e_f) < 1e-9
+         and abs(fit.bandwidth_efficiency - e_b) < 1e-9,
+         fitted=None if fit is None else fit.summary())
+
+    art = cal.correction_artifact({"syn_hw": fit})
+    loaded = cal.load_correction_artifact(art)
+    rebuilt = cal.correction_artifact_bytes(cal.correction_artifact(loaded))
+    roundtrip_ok = rebuilt == cal.correction_artifact_bytes(art)
+    tampered = dict(art)
+    tampered["corrections"] = dict(art["corrections"],
+                                   syn_hw=dict(art["corrections"]["syn_hw"],
+                                               flops_efficiency=1.0))
+    try:
+        cal.load_correction_artifact(tampered)
+        tamper_ok = False
+    except cal.CalibrationError:
+        tamper_ok = True
+    case("artifact_roundtrip_and_tamper", roundtrip_ok and tamper_ok)
+
+    # corrected sandwich over a real table: de-rating by positive scalars
+    # must preserve overlapped <= comm_overlap <= serial
+    cfg = ModelConfig(dim=16, n_layers=4, n_heads=2, vocab_size=64,
+                      ffn_dim=32, max_seq_len=16)
+    sandwich_ok, checked = True, []
+    for name, D, V, M in (("GPipe", 2, 1, 4), ("1F1B", 4, 1, 8),
+                          ("ZBH1", 4, 1, 8)):
+        cs = compile_schedule(name, D, V, M)
+        sec = cost_model_section(cs, cfg, batch_size=8, seq_length=16,
+                                 correction=fit)
+        corr = sec["predicted"]["corrected"]
+        ok = (corr["step_s_overlapped"]
+              <= corr["step_s_comm_overlap"] + 1e-12
+              <= corr["step_s"] + 1e-12)
+        sandwich_ok = sandwich_ok and ok
+        checked.append({"schedule": name, "ok": ok,
+                        "corrected_step_s": corr["step_s"]})
+    case("corrected_sandwich", sandwich_ok, entries=checked)
+
+    bad_rejected = 0
+    for bad in ({}, {"schema_version": 99}, dict(rows[0], kind="wrong"),
+                dict(rows[0], predicted={"no_step": 1.0})):
+        try:
+            cal.validate_ledger_row(bad)
+        except cal.CalibrationError:
+            bad_rejected += 1
+    case("malformed_rows_rejected", bad_rejected == 4,
+         n_rejected=bad_rejected)
+
+    return {"cases": cases, "n_checked": len(cases),
+            "n_bad": sum(1 for c in cases if not c["ok"]),
+            "ok": all(c["ok"] for c in cases)}
+
+
 def run_checks(tables: bool = True, lint: bool = True,
                jaxpr: bool = False, search: bool = False,
                search_out: Optional[str] = None,
-               memory: bool = False, overlap: bool = False) -> Dict[str, Any]:
+               memory: bool = False, overlap: bool = False,
+               calibration: bool = False) -> Dict[str, Any]:
     report: Dict[str, Any] = {"verifier_version": VERIFIER_VERSION}
     ok = True
     if tables:
@@ -408,6 +522,9 @@ def run_checks(tables: bool = True, lint: bool = True,
     if overlap:
         report["overlap"] = run_overlap_checks()
         ok = ok and report["overlap"]["ok"]
+    if calibration:
+        report["calibration"] = run_calibration_checks()
+        ok = ok and report["calibration"]["ok"]
     if lint:
         report["lint"] = run_lint()
         ok = ok and report["lint"]["ok"]
@@ -450,6 +567,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "pin step_s_overlapped <= step_s_comm_overlap <= "
                          "step_s plus the two-buffer hop census (host-side, "
                          "no backend)")
+    ap.add_argument("--calibration", action="store_true",
+                    help="structural checks over the calibration "
+                         "observatory: probe-grid determinism/coverage, "
+                         "synthetic least-squares recovery, correction-"
+                         "artifact roundtrip + tamper rejection, corrected "
+                         "sandwich, malformed-ledger-row rejection "
+                         "(host-side, no backend)")
     ap.add_argument("--all", action="store_true", help="all three passes")
     ap.add_argument("--json", metavar="PATH",
                     help="write the structured report to PATH")
@@ -463,12 +587,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     search = args.search or args.all
     memory = args.memory or args.all
     overlap = args.overlap or args.all
-    if not (tables or lint or jaxpr or search or memory or overlap):
+    calibration = args.calibration or args.all
+    if not (tables or lint or jaxpr or search or memory or overlap
+            or calibration):
         tables = lint = True  # cheap default: no jax import needed
 
     report = run_checks(tables=tables, lint=lint, jaxpr=jaxpr,
                         search=search, search_out=args.search_out,
-                        memory=memory, overlap=overlap)
+                        memory=memory, overlap=overlap,
+                        calibration=calibration)
 
     if not args.quiet:
         if "tables" in report:
@@ -507,6 +634,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     print(f"  {r['name']}[D={r['n_devices']},"
                           f"V={r['n_virtual']},M={r['n_microbatches']}]: "
                           f"{p}")
+        if "calibration" in report:
+            ca = report["calibration"]
+            print(f"calibration: {ca['n_checked']} checks, "
+                  f"{ca['n_bad']} failures")
+            for c in ca["cases"]:
+                if not c["ok"]:
+                    print(f"  {c['case']}: FAIL "
+                          f"{ {k: v for k, v in c.items() if k not in ('case', 'ok')} }")
         if "lint" in report:
             li = report["lint"]
             print(f"lint: {li['n_findings']} findings")

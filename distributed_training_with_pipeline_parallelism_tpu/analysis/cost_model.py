@@ -51,7 +51,8 @@ __all__ = [
     "HardwareSpec", "CPU_PROXY", "TPU_PRESETS", "hardware_spec_for",
     "detect_hardware", "fwd_flops_per_token", "train_flops_per_token",
     "resolve_backward_policy", "backward_weights", "dtype_bytes",
-    "predicted_step_time", "comm_overlap_step_time", "cost_model_section",
+    "predicted_step_time", "comm_overlap_step_time",
+    "predicted_tick_seconds", "cost_model_section",
     "serving_cost_model_section",
 ]
 
@@ -259,6 +260,7 @@ def comm_overlap_step_time(table: np.ndarray,
                            unit_s: Tuple[float, float, float],
                            hop_s: float,
                            bank_stages: Optional[np.ndarray] = None,
+                           correction=None,
                            ) -> Dict[str, float]:
     """Predicted step time under the DOUBLE-BUFFERED executor
     (``comm_overlap="ring"``) — the first-class mode between the lockstep
@@ -284,7 +286,19 @@ def comm_overlap_step_time(table: np.ndarray,
     orderings can differ tick-by-tick, but hold summed on real schedule
     tables — ``scripts/check.py --overlap`` asserts the grid-wide
     ``<= step_s`` invariant and the search smoke pins the strict
-    sandwich on searched artifacts)."""
+    sandwich on searched artifacts).
+
+    ``correction``: an ``analysis.calibration.CorrectionFactors`` (or
+    any object with ``flops_efficiency``/``bandwidth_efficiency``) — the
+    per-hardware efficiency scalars fitted from measured probes; when
+    present the inputs are de-rated (``unit_s / e_flops``,
+    ``hop_s / e_bw``) before pricing, which preserves the envelope
+    ordering (both scalings are positive)."""
+    if correction is not None:
+        e_f = float(correction.flops_efficiency)
+        e_b = float(correction.bandwidth_efficiency)
+        unit_s = (unit_s[0] / e_f, unit_s[1] / e_f, unit_s[2] / e_f)
+        hop_s = hop_s / e_b
     table = np.asarray(table)
     if bank_stages is None:
         bank_stages = overlap_bank_stages(table)
@@ -312,6 +326,53 @@ def comm_overlap_step_time(table: np.ndarray,
     }
 
 
+def predicted_tick_seconds(table: np.ndarray,
+                           unit_s: Tuple[float, float, float],
+                           hop_s: float,
+                           bank_stages: Optional[np.ndarray] = None,
+                           correction=None) -> np.ndarray:
+    """Per-tick predicted seconds ``[T]`` under the double-buffered
+    attribution of :func:`comm_overlap_step_time` — the vector the
+    Perfetto exporter lays beside each measured tick slice so
+    predicted-vs-measured disagreement is visible per tick, not just as
+    one summed scalar. Sums exactly to ``step_s_comm_overlap``."""
+    if correction is not None:
+        e_f = float(correction.flops_efficiency)
+        e_b = float(correction.bandwidth_efficiency)
+        unit_s = (unit_s[0] / e_f, unit_s[1] / e_f, unit_s[2] / e_f)
+        hop_s = hop_s / e_b
+    table = np.asarray(table)
+    if bank_stages is None:
+        bank_stages = overlap_bank_stages(table)
+    activity = table_unit_activity(table)
+    vec = np.array([unit_s[0], unit_s[1], unit_s[2], 0.0], dtype=np.float64)
+    compute_tick_s = (activity.astype(np.float64) @ vec).max(axis=1)  # [T]
+    T = table.shape[0]
+    exposed = np.zeros(T, dtype=np.int64)
+    deferred = np.zeros(T, dtype=np.int64)
+    for u in range(1, T):
+        for ci, (_, col, _) in enumerate(_STORE_CHANNELS):
+            if (table[u, :, col] >= 0).any():
+                if bank_stages[u, ci] == BANK_BEFORE_F:
+                    exposed[u] += 1
+                else:
+                    deferred[u] += 1
+    return exposed * hop_s + np.maximum(compute_tick_s, deferred * hop_s)
+
+
+def _resolve_correction(correction, hw_name: str):
+    """Accept a CorrectionFactors, a {hardware_name: CorrectionFactors}
+    mapping (the :func:`..analysis.calibration.load_correction_artifact`
+    shape), or None; return the factors for ``hw_name`` or None."""
+    if correction is None:
+        return None
+    if hasattr(correction, "flops_efficiency"):
+        return correction
+    if hasattr(correction, "get"):
+        return correction.get(hw_name)
+    return None
+
+
 def cost_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
                        seq_length: int,
                        hardware: Optional[HardwareSpec] = None,
@@ -319,7 +380,8 @@ def cost_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
                        measured_step_s: Optional[float] = None,
                        telemetry=None,
                        table_report=None,
-                       comm_overlap: str = "none") -> Dict[str, Any]:
+                       comm_overlap: str = "none",
+                       correction=None) -> Dict[str, Any]:
     """Price one compiled schedule against a roofline; reconcile with a
     measured run when one is supplied.
 
@@ -331,8 +393,15 @@ def cost_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
     verified fresh via ``check_table`` when absent. ``comm_overlap``
     records the ring-hop discipline the run's executor compiled
     ("none"/"ring") — the ``step_s_comm_overlap`` prediction itself is
-    always reported (it prices the table, not the run). Returns the
-    plain dict that ``RunReport.attach_cost_model`` embeds."""
+    always reported (it prices the table, not the run).
+    ``correction``: calibration-fitted efficiency scalars (a
+    ``CorrectionFactors`` or the per-hardware mapping
+    ``analysis.calibration.load_correction_artifact`` returns) — when
+    one matches this run's hardware, ``predicted`` additionally carries
+    a ``corrected`` block (every step-time variant re-priced under the
+    de-rated roofline) and the measured reconciliation reports both
+    ``rel_err`` and ``rel_err_corrected``. Returns the plain dict that
+    ``RunReport.attach_cost_model`` embeds."""
     table = cs.table
     T, D = int(table.shape[0]), int(table.shape[1])
     hw = hardware if hardware is not None else detect_hardware()
@@ -422,6 +491,27 @@ def cost_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
         },
     }
 
+    corr = _resolve_correction(correction, hw.name)
+    if corr is not None:
+        # re-price every variant under the de-rated roofline; positive
+        # scalings preserve the serial/comm_overlap/overlapped envelope
+        e_f = float(corr.flops_efficiency)
+        unit_sec_c = tuple(u / e_f for u in unit_sec)
+        tm_c = predicted_step_time(
+            table, unit_sec_c, hop_s / float(corr.bandwidth_efficiency),
+            hops_total)
+        ov_c = comm_overlap_step_time(table, unit_sec, hop_s,
+                                      correction=corr)
+        section["predicted"]["corrected"] = {
+            "flops_efficiency": e_f,
+            "bandwidth_efficiency": float(corr.bandwidth_efficiency),
+            "compute_s": tm_c["compute_s"],
+            "comm_s": tm_c["comm_s"],
+            "step_s": tm_c["step_s"],
+            "step_s_overlapped": tm_c["step_s_overlapped"],
+            "step_s_comm_overlap": ov_c["step_s_comm_overlap"],
+        }
+
     if telemetry is not None and getattr(telemetry, "events", None):
         if measured_step_s is None:
             measured_step_s = sum((rec.get("duration_s") or 0.0)
@@ -442,7 +532,15 @@ def cost_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
             "hfu": hardware_per_step / chip_s,
             "predicted_over_measured":
                 section["predicted"]["step_s"] / measured_step_s,
+            # signed relative error, the calibration ledger's headline
+            # axis: negative = the roofline is optimistic
+            "rel_err": (section["predicted"]["step_s"] - measured_step_s)
+                / measured_step_s,
         }
+        corrected = section["predicted"].get("corrected")
+        if corrected is not None:
+            measured["rel_err_corrected"] = \
+                (corrected["step_s"] - measured_step_s) / measured_step_s
         if telemetry is not None and getattr(telemetry, "events", None):
             sb = telemetry.stage_breakdown()
             if "bubble_measured_mean" in sb:

@@ -51,7 +51,28 @@ __all__ = [
     "activation_slot_bytes", "params_bytes", "stored_residual_bytes",
     "memory_model_section", "serving_memory_section",
     "compiled_memory_section", "reconcile_memory", "oom_preflight",
+    "memory_probe_axes",
 ]
+
+
+def memory_probe_axes(section: Dict[str, Any]
+                      ) -> Dict[str, Optional[float]]:
+    """The (predicted, measured) peak-bytes pair a calibration ledger row
+    records, extracted from a ``memory_model_section`` dict: analytic
+    per-device peak on the predicted side, XLA's compiled ``temp_bytes``
+    on the measured side (None when the AOT analysis was unavailable or
+    degraded to an error row)."""
+    analytic = section.get("analytic") or {}
+    compiled = section.get("compiled") or {}
+    predicted = analytic.get("peak_bytes")
+    measured = (compiled.get("temp_bytes")
+                if "error" not in compiled else None)
+    return {
+        "predicted_peak_bytes":
+            None if predicted is None else float(predicted),
+        "measured_peak_bytes":
+            None if measured is None else float(measured),
+    }
 
 
 def _tree_bytes(shapes) -> int:

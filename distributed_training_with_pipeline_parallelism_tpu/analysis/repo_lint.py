@@ -60,6 +60,20 @@ Each rule encodes a contract documented elsewhere in the repo
     synced anyway; a fetch anywhere else adds a device round-trip per
     step and silently serializes the pipeline.
 
+``raw-step-timing``
+    No direct host-clock *calls* (``time.time()``,
+    ``time.perf_counter()``, ``time.perf_counter_ns()``,
+    ``time.monotonic()``) outside the sanctioned timing surfaces:
+    ``utils/telemetry.py`` (stamp recorder + event log),
+    ``utils/metrics.py`` (the timed benchmark loop),
+    ``utils/profiling.py``, ``utils/train.py`` (log-window wall clock),
+    ``utils/resilience.py`` (checkpoint stamps), ``serving/engine.py``
+    (serving wall clock), and ``analysis/calibration.py`` (the probe
+    harness). Anywhere else, a raw clock read is an ad-hoc step timing
+    that bypasses the predicted-vs-measured calibration ledger
+    (docs/observability.md §9) — route it through ``utils.metrics`` /
+    telemetry so every measurement is reconcilable with the cost model.
+
 The linter is stdlib-only (``ast``) — no jax import, safe for CI legs
 that run before any backend exists.
 """
@@ -351,6 +365,33 @@ def _lint_dynamics_sync_reads(tree: ast.AST, path: str,
                 break
 
 
+# raw-step-timing: modules allowed to read host clocks directly — the
+# sanctioned timing surfaces plus the calibration probe harness (see
+# the rule docstring). Everything else must time through them.
+_RAW_TIMING_ALLOWLIST = ("utils/telemetry.py", "utils/metrics.py",
+                         "utils/profiling.py", "utils/resilience.py",
+                         "utils/train.py", "serving/engine.py",
+                         "analysis/calibration.py")
+_RAW_TIMING_CALLS = frozenset({"time.time", "time.perf_counter",
+                               "time.perf_counter_ns", "time.monotonic"})
+
+
+def _lint_raw_step_timing(tree: ast.AST, path: str,
+                          findings: List[LintFinding]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted_name(node.func)
+        if dotted in _RAW_TIMING_CALLS:
+            findings.append(LintFinding(
+                path, node.lineno, "raw-step-timing",
+                f"{dotted}(): raw host-clock read outside the sanctioned "
+                f"timing surfaces (utils/metrics.py, utils/telemetry.py, "
+                f"...) — ad-hoc step timing bypasses the calibration "
+                f"ledger (docs/observability.md §9); route measurements "
+                f"through utils.metrics / telemetry stamps"))
+
+
 def lint_source(path: str, source: str,
                 package_relpath: Optional[str] = None) -> List[LintFinding]:
     """Lint one python source. ``package_relpath`` is the path relative to
@@ -376,6 +417,8 @@ def lint_source(path: str, source: str,
         _lint_dynamics_sync_reads(tree, path, findings)
     if rel_posix == "parallel/tensor_parallel.py":
         _lint_tp_bare_collectives(tree, path, findings)
+    if rel_posix not in _RAW_TIMING_ALLOWLIST:
+        _lint_raw_step_timing(tree, path, findings)
     return findings
 
 

@@ -178,15 +178,27 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
         sim = simulated_bubble(cs, w_f=1.0, w_b=w_b, w_w=w_w)
         # the full roofline section (predicted vs measured step time,
         # table-exact bubble, MFU) — its headline numbers also land as
-        # sweep columns so schedule comparisons stay one-DataFrame reads
+        # sweep columns so schedule comparisons stay one-DataFrame reads;
+        # fitted calibration corrections (scripts/probe.py) apply when
+        # the artifact is present
+        from ..analysis.calibration import maybe_load_default_corrections
+        corrections = maybe_load_default_corrections()
         cost_model = cost_model_section(
             cs, cfg, batch_size=batch_size, seq_length=seq_length,
             remat_backward=remat_backward,
-            measured_step_s=metrics["elapsed_time"] / num_iterations)
+            measured_step_s=metrics["elapsed_time"] / num_iterations,
+            correction=corrections)
         metrics.update({
             "throughput_per_chip": metrics["throughput"] / num_devices,
             "n_virtual": n_virtual,
             "n_microbatches": n_microbatches,
+            # first-class predicted-vs-measured columns (the calibration
+            # ledger's headline axis; scripts/regress.py extracts these
+            # uniformly from sweep rows and bench results)
+            "predicted_step_s": cost_model["predicted"]["step_s"],
+            "rel_err": cost_model.get("measured", {}).get("rel_err"),
+            "rel_err_corrected": cost_model.get("measured", {}).get(
+                "rel_err_corrected"),
             "bubble_analytic": analytic_bubble_fraction(
                 schedule_type, num_devices, n_virtual, n_microbatches, cs=cs),
             "bubble_simulated": sim["bubble_fraction"],
@@ -239,6 +251,15 @@ def run_one_experiment(n_layers: int, n_heads: int, num_devices: int,
             for k, v in metrics.items():
                 report.gauge(k, v)
             report.attach_cost_model(cost_model)
+            # the run's own predicted-vs-measured point as a calibration
+            # section (docs/observability.md §9)
+            from ..analysis.calibration import (
+                calibration_section_from_cost_model)
+            cal_section = calibration_section_from_cost_model(
+                cost_model, backend=jax.devices()[0].platform,
+                name=f"sweep_{schedule_type}", correction=corrections)
+            if cal_section is not None:
+                report.attach_calibration(cal_section)
             # bytes-domain section: the preflight's analytic model plus
             # XLA's own accounting (free — the step is already compiled)
             from ..parallel.pipeline import aot_memory_analysis

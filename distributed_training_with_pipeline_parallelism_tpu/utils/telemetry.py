@@ -481,7 +481,8 @@ def critical_path(telemetry: PipelineTelemetry) -> Dict[str, Any]:
 
 def perfetto_trace(telemetry: PipelineTelemetry,
                    serving_events: Optional[List[Dict[str, Any]]] = None,
-                   dynamics_events: Optional[List[Dict[str, Any]]] = None
+                   dynamics_events: Optional[List[Dict[str, Any]]] = None,
+                   predicted_tick_s: Optional[Sequence[float]] = None
                    ) -> Dict[str, Any]:
     """The measured timeline as a Chrome-trace/Perfetto JSON object.
 
@@ -503,6 +504,13 @@ def perfetto_trace(telemetry: PipelineTelemetry,
     (:func:`perfetto_request_events`). ``dynamics_events``: RunReport
     ``dynamics`` event rows — per-stage grad-norm counter tracks on a
     "training dynamics" process (:func:`perfetto_dynamics_events`).
+    ``predicted_tick_s``: the cost model's per-tick predicted seconds
+    (``analysis.cost_model.predicted_tick_seconds``, length ``T``) — when
+    given, every per-tick slice's args additionally carry
+    ``predicted_tick_s`` / ``measured_tick_s`` / ``rel_err`` (signed,
+    predicted vs measured), so clicking any slice answers "was this tick
+    slower than the model said" without leaving the UI (the calibration
+    observatory's per-tick view, docs/observability.md §9).
     Timestamps are microseconds from the first stamp, sorted ascending;
     load the written file in ui.perfetto.dev or chrome://tracing."""
     from ..parallel.schedules import (COL_BWD_M, COL_BWD_V, COL_FWD_M,
@@ -524,8 +532,19 @@ def perfetto_trace(telemetry: PipelineTelemetry,
                        "ts": 0.0, "args": {"name": f"device {d}"}})
     units = ((COL_FWD_V, COL_FWD_M, "F"), (COL_BWD_V, COL_BWD_M, "B"),
              (COL_W_V, COL_W_M, "W"))
+    n_predicted = 0
     for t in range(T):
         ts, width = t0[t] * us, dur[t] * us
+        # calibration annotation: the cost model's prediction for this
+        # tick next to its measured duration, on every slice of the tick
+        pred_args: Dict[str, Any] = {}
+        if predicted_tick_s is not None and t < len(predicted_tick_s):
+            n_predicted += 1
+            p = float(predicted_tick_s[t])
+            pred_args = {"predicted_tick_s": p,
+                         "measured_tick_s": float(dur[t])}
+            if dur[t] > 0:
+                pred_args["rel_err"] = (p - float(dur[t])) / float(dur[t])
         for d in range(D):
             row = table[t, d]
             active = 0
@@ -538,11 +557,11 @@ def perfetto_trace(telemetry: PipelineTelemetry,
                     events.append({
                         "ph": "X", "name": name, "cat": kind, "pid": 0,
                         "tid": d, "ts": ts, "dur": width,
-                        "args": {"tick": t, "v": v, "m": m}})
+                        "args": {"tick": t, "v": v, "m": m, **pred_args}})
             if active == 0:
                 events.append({"ph": "X", "name": "idle", "cat": "idle",
                                "pid": 0, "tid": d, "ts": ts, "dur": width,
-                               "args": {"tick": t}})
+                               "args": {"tick": t, **pred_args}})
     # flow args carry the hop's verified bank stage so overlapped comm
     # reads directly off the arrows: stage 0 arrivals fence the landing
     # tick's first unit (exposed), later stages ride under its compute
@@ -605,7 +624,8 @@ def perfetto_trace(telemetry: PipelineTelemetry,
                       "n_ticks": T, "n_flows": flow_id,
                       "n_overlappable_flows": n_overlappable,
                       "n_memory_counters": n_counters,
-                      "n_dynamics_counters": n_dyn},
+                      "n_dynamics_counters": n_dyn,
+                      "n_predicted_ticks": n_predicted},
     }
 
 
@@ -786,7 +806,8 @@ def perfetto_dynamics_events(dynamics_events: List[Dict[str, Any]],
 def write_perfetto_trace(telemetry: Optional[PipelineTelemetry], path: str,
                          serving_events: Optional[List[Dict[str, Any]]] = None,
                          dynamics_events: Optional[List[Dict[str, Any]]] = None,
-                         serving_load_tracks: Optional[Dict[str, Any]] = None
+                         serving_load_tracks: Optional[Dict[str, Any]] = None,
+                         predicted_tick_s: Optional[Sequence[float]] = None
                          ) -> str:
     """Serialize :func:`perfetto_trace` to ``path``; returns the path.
     With ``telemetry=None`` (a serving-only run has no pipeline
@@ -795,7 +816,9 @@ def write_perfetto_trace(telemetry: Optional[PipelineTelemetry], path: str,
     process (:func:`perfetto_serving_load_events`): a dict with any of
     ``occupancy``/``queue_depth`` (block-boundary ``(tick, n)`` samples)
     and ``s_per_tick``; the request sub-spans come from
-    ``serving_events``."""
+    ``serving_events``. ``predicted_tick_s``: per-tick cost-model
+    predictions for the calibration annotations (see
+    :func:`perfetto_trace`)."""
     if telemetry is None:
         rows = perfetto_request_events(serving_events or [])
         rows.extend(perfetto_dynamics_events(dynamics_events or []))
@@ -806,7 +829,8 @@ def write_perfetto_trace(telemetry: Optional[PipelineTelemetry], path: str,
         }
     else:
         trace = perfetto_trace(telemetry, serving_events=serving_events,
-                               dynamics_events=dynamics_events)
+                               dynamics_events=dynamics_events,
+                               predicted_tick_s=predicted_tick_s)
     if serving_load_tracks is not None:
         trace["traceEvents"].extend(perfetto_serving_load_events(
             serving_events or [],
@@ -927,6 +951,7 @@ class RunReport:
         self.cost_model: Optional[Dict[str, Any]] = None
         self.memory: Optional[Dict[str, Any]] = None
         self.dynamics: Optional[Dict[str, Any]] = None
+        self.calibration: Optional[Dict[str, Any]] = None
         self.out_dir = out_dir
         self._events_fh = None
         # the event stream is written from the training loop AND from
@@ -1033,6 +1058,16 @@ class RunReport:
         guards."""
         self.memory = dict(section)
 
+    def attach_calibration(self, section: Dict[str, Any]) -> None:
+        """Embed the predicted-vs-measured calibration record
+        (:func:`analysis.calibration.calibration_section`: compact
+        per-config probe rows, the raw-vs-corrected median error
+        summary, the fitted per-hardware correction factors and the
+        ledger path) as the manifest's ``calibration`` block — the
+        model-trust record ``scripts/regress.py`` guards and the PR-19
+        planner search will consume."""
+        self.calibration = dict(section)
+
     # -- output ---------------------------------------------------------
 
     def manifest(self) -> Dict[str, Any]:
@@ -1064,6 +1099,8 @@ class RunReport:
             out["memory"] = _jsonable(self.memory)
         if self.dynamics is not None:
             out["dynamics"] = _jsonable(self.dynamics)
+        if self.calibration is not None:
+            out["calibration"] = _jsonable(self.calibration)
         return out
 
     def write(self, path: Optional[str] = None) -> Dict[str, Any]:
@@ -1380,3 +1417,59 @@ def validate_report(manifest: Dict[str, Any]) -> None:
         if not isinstance(bundles, list) or not all(
                 isinstance(b, str) for b in bundles):
             fail("dynamics.forensic_bundles must be a list of filenames")
+    cal = manifest.get("calibration")
+    if cal is not None:
+        if not isinstance(cal, dict):
+            fail("calibration must be a dict")
+        if not isinstance(cal.get("schema_version"), int):
+            fail("calibration.schema_version must be an int")
+        rows = cal.get("rows")
+        if not isinstance(rows, list):
+            fail("calibration.rows must be a list")
+        if cal.get("n_rows") != len(rows):
+            fail(f"calibration.n_rows ({cal.get('n_rows')!r}) must equal "
+                 f"len(rows) ({len(rows)})")
+        for row in rows:
+            if not isinstance(row, dict):
+                fail("calibration.rows entries must be dicts")
+            for key in ("schedule", "schedule_family", "backward_policy",
+                        "comm_overlap"):
+                if not isinstance(row.get(key), str):
+                    fail(f"calibration row {key!r} must be a string")
+            for key in ("n_devices", "n_microbatches"):
+                if not isinstance(row.get(key), int):
+                    fail(f"calibration row {key!r} must be an int")
+            # predicted/measured/rel_err may be null (backfilled rows with
+            # only one side of the comparison) but must be present
+            for key in ("predicted_step_s", "measured_step_s", "rel_err"):
+                if key not in row:
+                    fail(f"calibration row missing {key!r}")
+                if row[key] is not None and not isinstance(
+                        row[key], (int, float)):
+                    fail(f"calibration row {key!r} must be a number or null")
+        summary = cal.get("summary")
+        if not isinstance(summary, dict):
+            fail("calibration.summary must be a dict")
+        for key in ("median_abs_rel_err_raw", "median_abs_rel_err_corrected"):
+            if key not in summary:
+                fail(f"calibration.summary missing {key!r}")
+            if summary[key] is not None and not isinstance(
+                    summary[key], (int, float)):
+                fail(f"calibration.summary.{key} must be a number or null")
+        if not isinstance(summary.get("groups"), dict):
+            fail("calibration.summary.groups must be a dict")
+        corr = cal.get("correction")
+        if corr is not None:
+            if not isinstance(corr, dict):
+                fail("calibration.correction must be a dict")
+            for hw_name, factors in corr.items():
+                if not isinstance(factors, dict):
+                    fail(f"calibration.correction[{hw_name!r}] must be "
+                         "a dict")
+                for key in ("flops_efficiency", "bandwidth_efficiency"):
+                    if not isinstance(factors.get(key), (int, float)):
+                        fail(f"calibration.correction[{hw_name!r}].{key} "
+                             "must be a number")
+        lp = cal.get("ledger_path")
+        if lp is not None and not isinstance(lp, str):
+            fail("calibration.ledger_path must be a string or null")

@@ -664,6 +664,9 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
             # accounting error take down the run's report
             if telemetry.events and data_shape is not None:
                 try:
+                    from ..analysis.calibration import (
+                        calibration_section_from_cost_model,
+                        maybe_load_default_corrections)
                     from ..analysis.cost_model import cost_model_section
                     from ..parallel.schedules import compile_schedule
                     cs = compile_schedule(sched.name, mesh.shape["pipe"],
@@ -671,11 +674,21 @@ def fit(cfg: ModelConfig, mesh: Mesh, sched: ScheduleConfig, params: Pytree,
                                           sched.n_microbatches)
                     if (telemetry.table is not None
                             and cs.table.shape == telemetry.table.shape):
-                        report.attach_cost_model(cost_model_section(
+                        corrections = maybe_load_default_corrections()
+                        cm = cost_model_section(
                             cs, cfg, batch_size=data_shape[0],
                             seq_length=data_shape[1],
                             remat_backward=remat_backward,
-                            telemetry=telemetry))
+                            telemetry=telemetry, correction=corrections)
+                        report.attach_cost_model(cm)
+                        # the run's own predicted-vs-measured point
+                        # (docs/observability.md §9)
+                        cal = calibration_section_from_cost_model(
+                            cm, backend=jax.devices()[0].platform,
+                            name=f"train_{sched.name}",
+                            correction=corrections)
+                        if cal is not None:
+                            report.attach_calibration(cal)
                 except Exception as e:
                     report.event("cost_model_error", error=str(e))
         if data_shape is not None:

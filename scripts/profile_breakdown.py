@@ -226,6 +226,17 @@ def cost_model_breakdown(cm: dict) -> None:
     print(f"{'':18s} {'predicted':>12s} {'measured':>12s}")
     print(f"{'step time':18s} {_ms(pred.get('step_s')):>12s} "
           f"{_ms(meas.get('step_s')):>12s}")
+    corr = pred.get("corrected")
+    if isinstance(corr, dict):
+        # fitted calibration corrections applied (docs/observability.md §9)
+        print(f"{'  corrected':18s} {_ms(corr.get('step_s')):>12s} "
+              f"{'':>12s}  (e_flops="
+              f"{corr.get('flops_efficiency', 0.0):.4g}, e_bw="
+              f"{corr.get('bandwidth_efficiency', 0.0):.4g})")
+        if isinstance(meas.get("rel_err"), (int, float)):
+            print(f"{'  rel err':18s} {meas['rel_err']:>+12.3f} "
+                  f"-> corrected "
+                  f"{meas.get('rel_err_corrected', float('nan')):+.3f}")
     print(f"{'bubble (exact)':18s} "
           f"{_pct(pred.get('bubble_table_exact')):>12s} "
           f"{_pct(meas.get('bubble_measured_mean')):>12s}")
@@ -408,6 +419,54 @@ def serving_load_breakdown(sl: dict) -> None:
               f"{ref.get('goodput')} (regression-tracked)")
 
 
+def calibration_breakdown(cal: dict) -> None:
+    """Print a manifest's ``calibration`` section: the per-config
+    predicted-vs-measured table (raw and corrected), the grouped error
+    medians, and the fitted per-hardware correction factors
+    (analysis.calibration; docs/observability.md §9)."""
+    summary = cal.get("summary") or {}
+    print(f"\n--- calibration: {cal.get('n_rows', 0)} row(s), "
+          f"ledger={cal.get('ledger_path') or 'n/a'} ---")
+
+    def _e(v, width=9):
+        return (f"{v:+{width}.3f}" if isinstance(v, (int, float))
+                else f"{'n/a':>{width}s}")
+
+    def _ms(v):
+        return (f"{v * 1e3:9.3f}" if isinstance(v, (int, float))
+                else f"{'n/a':>9s}")
+
+    rows = cal.get("rows") or []
+    if rows:
+        print(f"{'config':38s} {'pred ms':>9s} {'corr ms':>9s} "
+              f"{'meas ms':>9s} {'err':>9s} {'corr err':>9s}")
+        for r in rows:
+            label = (f"{r.get('schedule', '?')}[D={r.get('n_devices', '?')}"
+                     f",M={r.get('n_microbatches', '?')}]"
+                     f"/{r.get('backward_policy', '?')}"
+                     f"/{r.get('comm_overlap', '?')}")
+            print(f"{label:38s} {_ms(r.get('predicted_step_s'))} "
+                  f"{_ms(r.get('predicted_step_s_corrected'))} "
+                  f"{_ms(r.get('measured_step_s'))} "
+                  f"{_e(r.get('rel_err'))} {_e(r.get('rel_err_corrected'))}")
+    raw = summary.get("median_abs_rel_err_raw")
+    cor = summary.get("median_abs_rel_err_corrected")
+    print(f"median |rel err|: raw "
+          f"{raw if raw is None else format(raw, '.4f')} -> corrected "
+          f"{cor if cor is None else format(cor, '.4f')}")
+    for key, g in (summary.get("groups") or {}).items():
+        med = g.get("median_rel_err")
+        print(f"  group {key}: n={g.get('n', 0)} "
+              f"(with err: {g.get('n_with_err', 0)}), median rel err "
+              f"{med if med is None else format(med, '+.3f')}")
+    for hw, cf in (cal.get("correction") or {}).items():
+        print(f"correction[{hw}]: e_flops="
+              f"{cf.get('flops_efficiency', 0.0):.4g}, e_bw="
+              f"{cf.get('bandwidth_efficiency', 0.0):.4g} "
+              f"(fit over {cf.get('n_rows', 0)} rows, residual rms "
+              f"{cf.get('residual_rms', 0.0):.3e}s)")
+
+
 def report_breakdown(manifest: dict) -> None:
     """Print the telemetry + cost_model (+ memory, + dynamics) sections
     of a run-report manifest: phase/tick timeline, per-stage F/B/W/idle
@@ -426,13 +485,17 @@ def report_breakdown(manifest: dict) -> None:
         # has tables worth printing
         dyn = manifest.get("dynamics")
         sl = manifest.get("serving_load")
-        if isinstance(dyn, dict) or isinstance(sl, dict):
+        cal = manifest.get("calibration")
+        if isinstance(dyn, dict) or isinstance(sl, dict) \
+                or isinstance(cal, dict):
             print(f"=== run report: {meta.get('name', '?')} "
                   f"(backend={meta.get('backend', '?')}) ===")
             if isinstance(dyn, dict):
                 dynamics_breakdown(dyn)
             if isinstance(sl, dict):
                 serving_load_breakdown(sl)
+            if isinstance(cal, dict):
+                calibration_breakdown(cal)
             return
         raise SystemExit(
             "report has neither a 'telemetry' nor a 'cost_model' section — "
@@ -483,6 +546,9 @@ def report_breakdown(manifest: dict) -> None:
     sl = manifest.get("serving_load")
     if isinstance(sl, dict):
         serving_load_breakdown(sl)
+    cal = manifest.get("calibration")
+    if isinstance(cal, dict):
+        calibration_breakdown(cal)
 
 
 def main():

@@ -27,7 +27,17 @@ the same (name, backend, schedule) group:
   double-buffered ring executor, docs/performance.md "Comm/compute
   overlap") drops by more than the threshold: a change that silently
   re-serializes the early-issued hops fails here. CPU-proxy runs stay
-  warn-only like every wall-clock gate below.
+  warn-only like every wall-clock gate below,
+- ``abs_rel_err`` (|predicted - measured| / measured step time, from the
+  ``cost_model`` section or the ``rel_err`` gauge) or
+  ``calib_abs_err_corrected`` (the ``calibration`` section's corrected
+  median |relative error| — docs/observability.md §9) rises by more
+  than the threshold — the model-trust guard: a change that quietly
+  makes the cost model (or its fitted corrections) worse at predicting
+  reality fails here before the auto-planner starts trusting bad
+  numbers. ``calib_abs_err_raw`` rides the history rows uncorrected
+  for comparison but is not gated (raw error is allowed to be bad —
+  that is what the corrections are for).
 
 Model-health metrics from the report's ``dynamics`` section (or sweep
 gauges) — ``grad_norm_final`` and ``gns`` — get WARN-only two-sided
@@ -111,6 +121,10 @@ def extract_metrics(manifest) -> dict:
             "max_sustainable_load": None,
             "serve_ttft_p99_ref": None,
             "overlap_tokens_per_sec": None,
+            "rel_err": None,
+            "abs_rel_err": None,
+            "calib_abs_err_raw": None,
+            "calib_abs_err_corrected": None,
         }
     gauges = manifest.get("gauges") or {}
     cm = manifest.get("cost_model")
@@ -157,6 +171,17 @@ def extract_metrics(manifest) -> dict:
     # gates are already warn-only, so the jittery serialized-tick number
     # never hard-fails the sentinel
     overlap_tps = _num(gauges.get("overlap_on_tokens_per_sec"))
+    # calibration observatory (docs/observability.md §9): the model-trust
+    # axes — per-run signed error from the cost_model section (or the
+    # first-class sweep/bench gauge), plus the probe grid's raw and
+    # corrected medians from the calibration section
+    rel_err = _num(_get(cm, "measured", "rel_err"))
+    if rel_err is None:
+        rel_err = _num(gauges.get("rel_err"))
+    cal = manifest.get("calibration")
+    predicted_step_s = _get(cm, "predicted", "step_s")
+    if predicted_step_s is None:
+        predicted_step_s = _num(gauges.get("predicted_step_s"))
     return {
         "t": time.time(),
         "name": _get(manifest, "meta", "name") or "unknown",
@@ -168,7 +193,7 @@ def extract_metrics(manifest) -> dict:
         "tokens_per_sec": tokens_per_sec,
         "mfu": mfu,
         "bubble": bubble,
-        "predicted_step_s": _get(cm, "predicted", "step_s"),
+        "predicted_step_s": predicted_step_s,
         "measured_step_s": _get(cm, "measured", "step_s"),
         "peak_temp_bytes": peak_temp,
         "peak_live_bytes": peak_live,
@@ -180,6 +205,12 @@ def extract_metrics(manifest) -> dict:
         "max_sustainable_load": max_sustainable,
         "serve_ttft_p99_ref": ttft_ref,
         "overlap_tokens_per_sec": overlap_tps,
+        "rel_err": rel_err,
+        "abs_rel_err": abs(rel_err) if rel_err is not None else None,
+        "calib_abs_err_raw": _num(_get(cal, "summary",
+                                       "median_abs_rel_err_raw")),
+        "calib_abs_err_corrected": _num(_get(cal, "summary",
+                                             "median_abs_rel_err_corrected")),
     }
 
 
@@ -228,7 +259,12 @@ def check(row, history, threshold, window) -> list:
                            ("peak_live_bytes", "up"),
                            ("max_sustainable_load", "down"),
                            ("serve_ttft_p99_ref", "up"),
-                           ("overlap_tokens_per_sec", "down")):
+                           ("overlap_tokens_per_sec", "down"),
+                           # model-trust guards: prediction error may not
+                           # quietly grow (missing in pre-calibration
+                           # history rows -> no prior -> skip)
+                           ("abs_rel_err", "up"),
+                           ("calib_abs_err_corrected", "up")):
         val = row.get(key)
         prior = [r[key] for r in group
                  if isinstance(r.get(key), (int, float))

@@ -491,7 +491,10 @@ def test_lint_ignores_host_call_outside_scan_body():
         "def setup():\n"
         "    return time.time()\n"
     )
-    assert lint_source("mod.py", src) == []
+    # not a scan-body violation (the raw-step-timing rule flags the same
+    # call site for its own reason — tests/test_calibration.py owns that)
+    findings = lint_source("mod.py", src)
+    assert not [f for f in findings if f.rule == "scan-body-host-call"]
 
 
 def test_lint_flags_eager_init_import():
