@@ -50,6 +50,7 @@ _LAZY = {
     "ServingEngine": ("serving", "ServingEngine"),
     "make_serving_step_fn": ("serving", "make_serving_step_fn"),
     "run_serve_bench": ("serving.bench", "run_serve_bench"),
+    "run_paged_bench": ("serving.bench", "run_paged_bench"),
     # static analysis (docs/static_analysis.md)
     "check_table": ("analysis", "check_table"),
     "TableReport": ("analysis", "TableReport"),

@@ -106,6 +106,26 @@ def maybe_verify_serving(n_devices: int, n_slots: int) -> None:
             + "; ".join(str(h) for h in report.hazards[:8]))
 
 
+def maybe_verify_page_table(pages, *, refcount, n_pages: int,
+                            page_size: int, write_lo: int, write_hi: int,
+                            cow_dst: int = -1, slot: int = -1) -> None:
+    """Admission-time hook for the paged serving engine
+    (``serving.engine.ServingEngine._admit``): verify one slot's planned
+    page-table row against the pool's refcounts when
+    ``DTPP_VERIFY_TABLES`` is set (in-bounds, refcount-live, no aliased
+    or shared-page writes without COW)."""
+    if not verify_tables_enabled():
+        return
+    from .table_check import page_table_hazards
+    hazards = page_table_hazards(
+        pages, refcount=refcount, n_pages=n_pages, page_size=page_size,
+        write_lo=write_lo, write_hi=write_hi, cow_dst=cow_dst, slot=slot)
+    if hazards:
+        raise ValueError(
+            f"page-table discipline verification failed (slot={slot}): "
+            + "; ".join(str(h) for h in hazards[:8]))
+
+
 _LAZY = {
     "Hazard": ("table_check", "Hazard"),
     "TableReport": ("table_check", "TableReport"),
@@ -116,6 +136,8 @@ _LAZY = {
     "TableCheckBaseline": ("table_check", "TableCheckBaseline"),
     "check_forward_table": ("table_check", "check_forward_table"),
     "check_serving_ring": ("table_check", "check_serving_ring"),
+    "check_page_table": ("table_check", "check_page_table"),
+    "page_table_hazards": ("table_check", "page_table_hazards"),
     "static_analysis_section": ("table_check", "static_analysis_section"),
     "JaxprAudit": ("jaxpr_audit", "JaxprAudit"),
     "audit_jaxpr": ("jaxpr_audit", "audit_jaxpr"),
@@ -144,6 +166,11 @@ _LAZY = {
     "compiled_memory_section": ("memory_model", "compiled_memory_section"),
     "reconcile_memory": ("memory_model", "reconcile_memory"),
     "oom_preflight": ("memory_model", "oom_preflight"),
+    "size_page_pool": ("memory_model", "size_page_pool"),
+    "kv_page_bytes": ("memory_model", "kv_page_bytes"),
+    "kv_slot_bytes": ("memory_model", "kv_slot_bytes"),
+    "contiguous_slots_for_budget": ("memory_model",
+                                    "contiguous_slots_for_budget"),
     "comm_overlap_step_time": ("cost_model", "comm_overlap_step_time"),
     "predicted_tick_seconds": ("cost_model", "predicted_tick_seconds"),
     "memory_probe_axes": ("memory_model", "memory_probe_axes"),
@@ -189,4 +216,5 @@ def __dir__():
 
 __all__ = ["VERIFIER_VERSION", "verify_tables_enabled",
            "maybe_verify_schedule", "maybe_verify_forward_table",
-           "maybe_verify_serving", *sorted(_LAZY)]
+           "maybe_verify_serving", "maybe_verify_page_table",
+           *sorted(_LAZY)]

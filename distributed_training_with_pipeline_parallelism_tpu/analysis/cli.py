@@ -83,6 +83,21 @@ def run_table_checks(grid: Optional[List[GridEntry]] = None
     for D, M in ((2, 2), (4, 4), (4, 6)):
         reports.append(check_serving_ring(D, M).summary())
         n_hazards += reports[-1]["n_hazards"]
+    # ISSUE 19: page-table discipline over a synthetic paged ring — a
+    # 4-slot pool where slots 0/1 share a refcount-2 prefix page
+    # (read-only: their write spans start past it) and slots 2/3 hold
+    # private rows. Trailing zeros are null-page filler. The grid must
+    # come back hazard-free; the negative cases live in the unit tests.
+    paging = {
+        "page_size": 4, "n_pages": 16,
+        "page_tbl": [[1, 2, 3, 0], [1, 4, 5, 0],
+                     [6, 7, 8, 0], [9, 10, 11, 0]],
+        "refcount": [1, 2, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 0, 0, 0, 0],
+        "spans": [(4, 12), (4, 12), (0, 12), (0, 12)],
+        "cow_dst": [-1, -1, -1, -1],
+    }
+    reports.append(check_serving_ring(2, 4, paging=paging).summary())
+    n_hazards += reports[-1]["n_hazards"]
     return {"n_checked": len(reports), "n_hazards": n_hazards,
             "ok": n_hazards == 0, "reports": reports}
 

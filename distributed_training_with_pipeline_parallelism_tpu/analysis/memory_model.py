@@ -303,17 +303,66 @@ def memory_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
     return section
 
 
+def kv_page_bytes(cfg, *, n_devices: int, page_size: int) -> float:
+    """Bytes one K+V page pair costs per device (the paged pool's unit
+    price): ``2 x layers/D x page_size x n_kv x head_dim x dtype``."""
+    lps = cfg.n_layers // n_devices
+    n_kv = cfg.n_kv_heads or cfg.n_heads
+    return (2.0 * lps * page_size * n_kv * cfg.head_dim
+            * dtype_bytes(cfg.dtype))
+
+
+def kv_slot_bytes(cfg, *, n_devices: int, mlen_alloc: int) -> float:
+    """Bytes one contiguous slot's K+V cache costs per device — what
+    every slot reserves up front in non-paged serving."""
+    lps = cfg.n_layers // n_devices
+    n_kv = cfg.n_kv_heads or cfg.n_heads
+    return (2.0 * lps * mlen_alloc * n_kv * cfg.head_dim
+            * dtype_bytes(cfg.dtype))
+
+
+def size_page_pool(cfg, *, n_devices: int, page_size: int,
+                   budget_bytes: float) -> int:
+    """Largest ``n_pages`` (null page 0 included) whose per-device pool
+    fits ``budget_bytes`` — the ROADMAP's "oom_preflight bounds
+    page-pool sizing" knob. Returns 0 when not even two pages fit (a
+    pool needs the null page plus one usable page)."""
+    pg_b = kv_page_bytes(cfg, n_devices=n_devices, page_size=page_size)
+    n = int(budget_bytes // pg_b)
+    return n if n >= 2 else 0
+
+
+def contiguous_slots_for_budget(cfg, *, n_devices: int, mlen_alloc: int,
+                                budget_bytes: float) -> int:
+    """How many worst-case contiguous slots the same budget buys — the
+    paged-vs-contiguous comparison's matched-budget twin of
+    :func:`size_page_pool`."""
+    slot_b = kv_slot_bytes(cfg, n_devices=n_devices, mlen_alloc=mlen_alloc)
+    return int(budget_bytes // slot_b)
+
+
 def serving_memory_section(cfg, program, *,
                            hardware: Optional[HardwareSpec] = None,
-                           compiled: Optional[Dict[str, Any]] = None
+                           compiled: Optional[Dict[str, Any]] = None,
+                           prefix_stats: Optional[Dict[str, Any]] = None
                            ) -> Dict[str, Any]:
     """Memory section for a serving run (same manifest schema).
 
     Activation state is the ``[D, 1, C, dim]`` ring payload — one slab
     per device, priced as one ``act`` slot of ``C`` tokens. The dominant
-    term is the KV cache: ``2 x layers/D x n_slots x mlen_alloc x
-    n_kv_heads x head_dim`` per device in the compute dtype, sized from
-    the same expressions ``ServingProgram.init_state`` allocates with."""
+    term is the KV cache: contiguous mode prices ``2 x layers/D x
+    n_slots x mlen_alloc x n_kv_heads x head_dim`` per device; paged
+    mode (``program.paged``) prices the pool ``n_pages x page_size``
+    rows instead plus the int32 page table, sized from the same
+    expressions ``ServingProgram.init_state`` allocates with.
+
+    ``prefix_stats`` (paged runs; e.g. ``{"hit_rate": h,
+    "mean_prompt_len": p, "mean_budget": b}`` from a workload or a
+    measured run) adds the expected *demand* discount from prefix
+    sharing: a fraction ``h`` of prompt rows is served from shared
+    pages, so per-request page demand shrinks by ``h * p / (p + b)`` —
+    the pool does not get smaller, it admits proportionally more
+    requests."""
     hw = hardware if hardware is not None else detect_hardware()
     D = int(program.n_stages)
     M = int(program.n_slots)
@@ -321,7 +370,37 @@ def serving_memory_section(cfg, program, *,
     lps = cfg.n_layers // D
     n_kv = cfg.n_kv_heads or cfg.n_heads
     dt_b = dtype_bytes(cfg.dtype)
-    kv_dev_b = 2.0 * lps * M * program.mlen_alloc * n_kv * cfg.head_dim * dt_b
+    paged = bool(getattr(program, "paged", False))
+    paged_info: Optional[Dict[str, Any]] = None
+    if paged:
+        pg_b = kv_page_bytes(cfg, n_devices=D, page_size=program.page_size)
+        kv_dev_b = program.n_pages * pg_b
+        # int32 table + COW command pair, replicated on every device
+        tbl_b = 4.0 * M * (program.max_pages_per_slot + 2)
+        kv_dev_b += tbl_b
+        paged_info = {
+            "page_size": int(program.page_size),
+            "n_pages": int(program.n_pages),
+            "max_pages_per_slot": int(program.max_pages_per_slot),
+            "page_bytes_per_device": float(pg_b),
+            "pool_bytes_per_device": float(program.n_pages * pg_b),
+            "page_table_bytes_per_device": float(tbl_b),
+            # what the same bytes would have bought as contiguous slots
+            "contiguous_slot_bytes": float(kv_slot_bytes(
+                cfg, n_devices=D, mlen_alloc=program.mlen_alloc)),
+        }
+        if prefix_stats:
+            h = float(prefix_stats.get("hit_rate", 0.0))
+            p_len = float(prefix_stats.get("mean_prompt_len", 0.0))
+            b_len = float(prefix_stats.get("mean_budget", 0.0))
+            disc = (h * p_len / (p_len + b_len)
+                    if (p_len + b_len) > 0 else 0.0)
+            paged_info["expected_sharing_discount"] = round(disc, 6)
+            paged_info["effective_capacity_factor"] = (
+                round(1.0 / (1.0 - disc), 6) if disc < 1.0 else None)
+    else:
+        kv_dev_b = (2.0 * lps * M * program.mlen_alloc * n_kv
+                    * cfg.head_dim * dt_b)
     slot_b = C * cfg.dim * dt_b
     pb = params_bytes(cfg, D)
     per_device = []
@@ -354,6 +433,8 @@ def serving_memory_section(cfg, program, *,
     }
     if hw.hbm_bytes:
         analytic["hbm_frac"] = peak / hw.hbm_bytes
+    if paged_info is not None:
+        analytic["paged"] = paged_info
     section: Dict[str, Any] = {
         "schedule": "serving_ring",
         "n_devices": D,

@@ -1034,7 +1034,102 @@ def check_forward_table(table: np.ndarray, n_devices: int, n_virtual: int,
         compression={})
 
 
-def check_serving_ring(n_devices: int, n_slots: int) -> TableReport:
+def page_table_hazards(pages, *, refcount, n_pages: int, page_size: int,
+                       write_lo: int, write_hi: int, cow_dst: int = -1,
+                       slot: int = -1) -> List[Hazard]:
+    """Discipline hazards for one slot's planned page-table row
+    (ISSUE 19 satellite: the paged serving engine's admission-time
+    check, also exercised synthetically by the CLI grid).
+
+    ``pages`` is the allocated prefix of the row (table order: entry
+    ``i`` backs positions ``[i*ps, (i+1)*ps)``); ``refcount`` the pool's
+    per-page counts; ``[write_lo, write_hi)`` the position span the slot
+    will write over its lifetime (cached-prefix end through the final
+    chunk's junk tail). Rules:
+
+    - every entry in-bounds and (non-null entries) refcount-live;
+    - no duplicate non-null entries (aliased writes would corrupt);
+    - the row covers the write span (rows past the last allocated page
+      would scatter into the null page and read back garbage);
+    - no write lands in a shared (refcount > 1) page — the divergence
+      page must have been remapped to a private COW destination
+      (``cow_dst``) before admission.
+    """
+    ps = page_size
+    hazards: List[Hazard] = []
+    seen: Dict[int, int] = {}
+    for i, pg in enumerate(int(p) for p in pages):
+        if pg < 0 or pg >= n_pages:
+            hazards.append(Hazard(
+                "page-oob", slot, i, "page_tbl",
+                f"slot {slot} entry {i} -> page {pg} outside "
+                f"[0, {n_pages})"))
+            continue
+        if pg == 0:
+            continue  # null page: legal filler, never read as valid
+        if refcount[pg] < 1:
+            hazards.append(Hazard(
+                "page-dead", slot, i, "page_tbl",
+                f"slot {slot} entry {i} -> page {pg} is on the free list "
+                f"(refcount {int(refcount[pg])})"))
+        if pg in seen:
+            hazards.append(Hazard(
+                "page-dup", slot, i, "page_tbl",
+                f"slot {slot} entries {seen[pg]} and {i} alias page {pg}"))
+        seen[pg] = i
+    if len(pages) * ps < write_hi:
+        hazards.append(Hazard(
+            "page-underalloc", slot, -1, "page_tbl",
+            f"slot {slot}: {len(pages)} pages cover {len(pages) * ps} "
+            f"rows < write frontier {write_hi}"))
+    for i in range(write_lo // ps, min(-(-write_hi // ps), len(pages))):
+        pg = int(pages[i])
+        if 0 < pg < n_pages and refcount[pg] > 1 and pg != cow_dst:
+            hazards.append(Hazard(
+                "page-shared-write", slot, i, "page_tbl",
+                f"slot {slot} writes positions in page {pg} "
+                f"(refcount {int(refcount[pg])} > 1) without COW"))
+    return hazards
+
+
+def check_page_table(page_tbl, *, refcount, n_pages: int, page_size: int,
+                     spans, cow_dst=None, n_devices: int = 1) -> TableReport:
+    """Discipline report over a full ``[M, P_max]`` page table.
+
+    ``spans`` is a per-slot list of ``(write_lo, write_hi)`` position
+    spans (``(0, 0)`` for an idle slot — its row is skipped); ``cow_dst``
+    an optional per-slot COW destination list. Returns a
+    :class:`TableReport` (kind ``"serving"``) the CLI renders next to
+    the ring checks."""
+    hazards: List[Hazard] = []
+    M = len(page_tbl)
+    for slot in range(M):
+        lo, hi = spans[slot]
+        if hi <= 0:
+            continue
+        row = [int(p) for p in page_tbl[slot]]
+        while row and row[-1] == 0:
+            row.pop()  # trailing null filler is not an allocation
+        hazards.extend(page_table_hazards(
+            row, refcount=refcount, n_pages=n_pages, page_size=page_size,
+            write_lo=lo, write_hi=hi,
+            cow_dst=(cow_dst[slot] if cow_dst is not None else -1),
+            slot=slot))
+    return TableReport(
+        name="serving_paging", kind="serving", n_devices=n_devices,
+        n_virtual=1, n_microbatches=M, placement="wrap",
+        split_backward=False, makespan=M, hazards=hazards,
+        act_slots_used=[M] * n_devices, grad_slots_used=[0] * n_devices,
+        act_live_peak=[M] * n_devices, grad_live_peak=[0] * n_devices,
+        n_act_slots=M, n_grad_slots=0,
+        comm={"fwd_ring_pos": {"cells": M * n_devices,
+                               "hop_ticks": M}},
+        unit_counts={"F": M * n_devices, "B": 0, "W": 0, "idle": 0},
+        compression={})
+
+
+def check_serving_ring(n_devices: int, n_slots: int,
+                       paging=None) -> TableReport:
     """Verify the serving executor's implicit round-robin slot schedule.
 
     ``serving.engine`` has no tick table: at tick ``u`` device ``d`` serves
@@ -1048,9 +1143,20 @@ def check_serving_ring(n_devices: int, n_slots: int) -> TableReport:
     - bank alignment: the banked slot at ``u`` is the slot device ``D-1``
       served at ``u-1``;
     - per device, each period serves every slot exactly once (permutation).
+
+    ``paging`` (optional) additionally runs the page-table discipline
+    check: a dict with ``page_tbl``, ``refcount``, ``n_pages``,
+    ``page_size``, ``spans`` and optional ``cow_dst`` as accepted by
+    :func:`check_page_table`; its hazards are merged into this report.
     """
     D, M = n_devices, n_slots
     hazards: List[Hazard] = []
+    if paging is not None:
+        hazards.extend(check_page_table(
+            paging["page_tbl"], refcount=paging["refcount"],
+            n_pages=paging["n_pages"], page_size=paging["page_size"],
+            spans=paging["spans"], cow_dst=paging.get("cow_dst"),
+            n_devices=D).hazards)
     if M < D:
         hazards.append(Hazard(
             "ring-underfull", -1, -1, "n_slots",

@@ -3,8 +3,13 @@
 :mod:`.engine` — the slot-level executor: a jitted fixed-shape tick
 block over the pipe mesh plus a host-side scheduler that admits, retires
 and refills per-slot requests between blocks (ISSUE 7 tentpole).
+:mod:`.paging` — host-side paged KV allocation: page-pool free list
+with refcounts, radix prefix cache over page-sized token chunks, and
+the admission planner behind the engine's ``paged=True`` mode
+(ISSUE 19 tentpole).
 :mod:`.bench` — the synthetic Poisson-trace benchmark comparing
-continuous vs static batching.
+continuous vs static batching (plus the paged-vs-contiguous SLO
+comparison at matched HBM budget).
 :mod:`.loadgen` — seeded workload mixes + offered-load ramp sweeps (the
 SLO observatory's measurement substrate, ISSUE 16).
 :mod:`.slo` — SLO targets, attainment/goodput-under-SLO, and the
@@ -20,6 +25,15 @@ _LAZY = {
     "ServeResult": ("engine", "ServeResult"),
     "ServingEngine": ("engine", "ServingEngine"),
     "make_serving_step_fn": ("engine", "make_serving_step_fn"),
+    "AdmissionPlan": ("paging", "AdmissionPlan"),
+    "PagePool": ("paging", "PagePool"),
+    "PagedKVAllocator": ("paging", "PagedKVAllocator"),
+    "RadixPrefixCache": ("paging", "RadixPrefixCache"),
+    "pages_for": ("paging", "pages_for"),
+    "matched_budget_plan": ("bench", "matched_budget_plan"),
+    "run_paged_bench": ("bench", "run_paged_bench"),
+    "run_serve_bench": ("bench", "run_serve_bench"),
+    "synth_trace": ("bench", "synth_trace"),
     "WORKLOAD_MIXES": ("loadgen", "WORKLOAD_MIXES"),
     "make_workload": ("loadgen", "make_workload"),
     "sweep_offered_load": ("loadgen", "sweep_offered_load"),

@@ -16,6 +16,17 @@ defaults to 1.5x the ring's service capacity — oversaturated, so a
 queue is always waiting (TTFT includes queue wait) and the scheduler,
 not arrival gaps, decides slot occupancy; the finite trace still
 drains.
+
+:func:`run_paged_bench` is the ISSUE 19 twin: paged vs contiguous KV at
+a *matched per-device HBM budget*. Contiguous serving reserves the
+worst-case ``mlen_alloc`` tokens per slot; the paged engine buys a page
+pool with the same bytes and provisions slots against the trace's
+*actual* per-request demand (backpressure, not reservation, covers the
+tail), so the same budget admits more concurrent requests — and on the
+shared-prefix mix the radix cache skips repeated prefill on top. Both
+engines replay the same trace through their own once-compiled blocks;
+the row reports the slot counts, goodput/TTFT, prefix-hit gauges, and
+both memory sections priced against the shared budget.
 """
 
 from __future__ import annotations
@@ -170,4 +181,207 @@ def run_serve_bench(*, cfg: Optional[ModelConfig] = None, params=None,
         "ttft_p99_ticks_static": ss["ttft_ticks"]["p99"],
         "continuous": sc, "static": ss,
     }
+    return row
+
+
+def matched_budget_plan(cfg, trace, *, n_devices: int, n_slots: int,
+                        max_len: int, prefill_chunk: int, page_size: int,
+                        budget_bytes: Optional[float] = None
+                        ) -> Dict[str, Any]:
+    """Size both sides of the paged-vs-contiguous comparison from ONE
+    per-device KV byte budget.
+
+    Default budget: exactly ``n_slots`` worst-case contiguous slots —
+    the bytes the non-paged engine already spends. The contiguous side
+    gets ``contiguous_slots_for_budget`` slots (each reserving
+    ``mlen_alloc`` tokens); the paged side buys ``size_page_pool`` pages
+    with the same bytes and provisions slots against the trace's *mean*
+    per-request page demand (``ceil((plen + budget + C - 1)/page_size)``
+    — what a request actually touches, not what the worst case
+    reserves). Overcommit beyond the mean is safe by construction: pool
+    exhaustion defers admission (backpressure), it never fails a
+    request. The int32 page table (~KB) is priced by
+    ``serving_memory_section`` but ignored here — it is noise next to
+    one KV page."""
+    from ..analysis.memory_model import (contiguous_slots_for_budget,
+                                         kv_page_bytes, kv_slot_bytes,
+                                         size_page_pool)
+    from .paging import pages_for
+
+    mlen_alloc = max_len + prefill_chunk - 1
+    slot_b = kv_slot_bytes(cfg, n_devices=n_devices, mlen_alloc=mlen_alloc)
+    if budget_bytes is None:
+        budget_bytes = n_slots * slot_b
+    m_c = contiguous_slots_for_budget(cfg, n_devices=n_devices,
+                                      mlen_alloc=mlen_alloc,
+                                      budget_bytes=budget_bytes)
+    n_pages = size_page_pool(cfg, n_devices=n_devices, page_size=page_size,
+                             budget_bytes=budget_bytes)
+    if m_c < 1 or n_pages < 2:
+        raise ValueError(
+            f"budget {budget_bytes:.0f} B/device buys {m_c} contiguous "
+            f"slots and {n_pages} pages — the comparison needs >= 1 slot "
+            "and >= 2 pages on each side")
+    demand = [pages_for(len(r.prompt) + r.max_new_tokens
+                        + prefill_chunk - 1, page_size) for r in trace]
+    mean_pages = float(np.mean(demand)) if demand else 1.0
+    m_p = max(1, int((n_pages - 1) // mean_pages))
+    return {
+        "budget_bytes": float(budget_bytes),
+        "mlen_alloc": int(mlen_alloc),
+        "page_size": int(page_size),
+        "contiguous_slot_bytes": float(slot_b),
+        "page_bytes": float(kv_page_bytes(cfg, n_devices=n_devices,
+                                          page_size=page_size)),
+        "contiguous_slots": int(m_c),
+        "n_pages": int(n_pages),
+        "mean_pages_per_request": round(mean_pages, 6),
+        "max_pages_per_request": int(max(demand)) if demand else 0,
+        "paged_slots": int(m_p),
+    }
+
+
+def run_paged_bench(*, cfg: Optional[ModelConfig] = None, params=None,
+                    mesh=None, n_pipe: int = 2, n_slots: int = 4,
+                    prefill_chunk: int = 2, max_len: int = 32,
+                    prompt_max: int = 12, out_max: int = 16,
+                    page_size: int = 4, n_requests: int = 24,
+                    load: float = 1.2, mix: str = "prefix",
+                    loads=None, eos_id: Optional[int] = 1, seed: int = 0,
+                    budget_bytes: Optional[float] = None,
+                    report=None) -> Dict[str, Any]:
+    """Paged vs contiguous KV serving at a matched per-device HBM budget
+    (ISSUE 19's headline measurement); returns the JSON row.
+
+    ``n_slots`` names the budget (bytes for that many worst-case
+    contiguous slots) unless ``budget_bytes`` overrides it;
+    :func:`matched_budget_plan` splits the budget into the two engines'
+    geometries. Both engines replay the SAME ``mix`` trace (default the
+    shared-prefix mix — the workload radix caching exists for) through
+    their own once-compiled block. Greedy decoding makes per-request
+    tokens independent of scheduling, so the row asserts completions
+    match across engines before comparing anything. Pass ``loads`` (a
+    strictly increasing ramp) to additionally sweep both engines with
+    :func:`.loadgen.sweep_offered_load` and compare
+    ``max_sustainable_load`` at the knee — the column
+    ``scripts/regress.py`` guards."""
+    import jax
+
+    from ..models import transformer as tfm
+    from ..parallel.mesh import make_mesh
+    from .loadgen import make_workload
+
+    if cfg is None:
+        cfg = ModelConfig(arch="gpt2", dim=64, n_layers=4, n_heads=4,
+                          vocab_size=128, ffn_dim=128,
+                          max_seq_len=max_len + prefill_chunk - 1)
+    if mesh is None:
+        mesh = make_mesh(n_pipe=n_pipe)
+    if params is None:
+        params = tfm.transformer_init(jax.random.key(0), cfg)
+    D = int(mesh.shape["pipe"])
+
+    trace = make_workload(n_requests, mix, prefill_chunk=prefill_chunk,
+                          load=load, vocab_size=cfg.vocab_size, seed=seed)
+    plan = matched_budget_plan(cfg, trace, n_devices=D, n_slots=n_slots,
+                               max_len=max_len,
+                               prefill_chunk=prefill_chunk,
+                               page_size=page_size,
+                               budget_bytes=budget_bytes)
+
+    prog_c = make_serving_step_fn(cfg, mesh,
+                                  n_slots=plan["contiguous_slots"],
+                                  max_len=max_len, prompt_max=prompt_max,
+                                  out_max=out_max,
+                                  prefill_chunk=prefill_chunk,
+                                  eos_id=eos_id)
+    prog_p = make_serving_step_fn(cfg, mesh, n_slots=plan["paged_slots"],
+                                  max_len=max_len, prompt_max=prompt_max,
+                                  out_max=out_max,
+                                  prefill_chunk=prefill_chunk,
+                                  eos_id=eos_id, paged=True,
+                                  page_size=page_size,
+                                  n_pages=plan["n_pages"])
+    engines = {"contiguous": ServingEngine(prog_c, params, report=report),
+               "paged": ServingEngine(prog_p, params, report=report)}
+
+    results = {}
+    for name, eng in engines.items():
+        results[name] = eng.run(trace, policy="continuous")
+        # the one-compilation invariant holds per engine even with the
+        # paged gather/scatter path in the block
+        n_compiles = eng.program.step._cache_size()
+        if n_compiles != 1:
+            raise AssertionError(
+                f"{name} serving block compiled {n_compiles}x")
+
+    rc, rp = results["contiguous"], results["paged"]
+    by_rid = {c.rid: c.tokens for c in rc.completions
+              if getattr(c, "status", "ok") == "ok"}
+    outputs_match = all(by_rid.get(c.rid) == c.tokens
+                        for c in rp.completions
+                        if getattr(c, "status", "ok") == "ok")
+    sc, sp = serving_summary(rc), serving_summary(rp)
+    for s in (sc, sp):
+        for key in ("occupancy", "queue_depth", "pages_used",
+                    "page_fragmentation"):
+            s.pop(key, None)
+
+    plens = [len(r.prompt) for r in trace]
+    budgets = [r.max_new_tokens for r in trace]
+    mem = {}
+    try:
+        from ..analysis.memory_model import serving_memory_section
+        mem["contiguous"] = serving_memory_section(cfg, prog_c)
+        mem["paged"] = serving_memory_section(
+            cfg, prog_p,
+            prefix_stats={
+                "hit_rate": rp.prefix_hit_rate or 0.0,
+                "mean_prompt_len": float(np.mean(plens)),
+                "mean_budget": float(np.mean(budgets)),
+            })
+        if report is not None:
+            report.attach_memory(mem["paged"])
+    except Exception:  # pragma: no cover - accounting never fails a run
+        mem = {}
+
+    row: Dict[str, Any] = {
+        "bench": "paged_serve",
+        "n_pipe": D, "prefill_chunk": prefill_chunk,
+        "n_requests": n_requests, "load": load, "mix": mix,
+        "eos_id": eos_id, "seed": seed,
+        "budget": plan,
+        "contiguous_slots": plan["contiguous_slots"],
+        "paged_slots": plan["paged_slots"],
+        "slot_gain": plan["paged_slots"] / plan["contiguous_slots"],
+        "outputs_match": bool(outputs_match),
+        "goodput_contiguous": sc["goodput"],
+        "goodput_paged": sp["goodput"],
+        "goodput_gain": (sp["goodput"] / sc["goodput"]
+                         if sc["goodput"] else None),
+        "ticks_contiguous": sc["ticks"], "ticks_paged": sp["ticks"],
+        "ttft_p99_ticks_contiguous": sc["ttft_ticks"]["p99"],
+        "ttft_p99_ticks_paged": sp["ttft_ticks"]["p99"],
+        "prefix_hit_rate": sp.get("prefix_hit_rate"),
+        "prefill_skipped_tokens": sp.get("prefill_skipped_tokens"),
+        "n_cow": sp.get("n_cow"),
+        "n_backpressure": sp.get("n_backpressure"),
+        "contiguous": sc, "paged": sp,
+    }
+    if mem:
+        row["memory"] = mem
+    if loads is not None:
+        from .loadgen import sweep_offered_load
+        sweeps = {name: sweep_offered_load(
+            eng, loads, mix=mix, n_requests=n_requests, seed=seed)
+            for name, eng in engines.items()}
+        row["serving_load"] = sweeps
+        row["max_sustainable_load_contiguous"] = \
+            sweeps["contiguous"]["knee"]["max_sustainable_load"]
+        row["max_sustainable_load_paged"] = \
+            sweeps["paged"]["knee"]["max_sustainable_load"]
+        if report is not None:
+            report.attach_serving_load(sweeps["paged"])
+    if report is not None:
+        report.attach_serving(sp)
     return row

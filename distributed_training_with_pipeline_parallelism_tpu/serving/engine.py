@@ -56,7 +56,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.generate import _embed_at
+from ..models.generate import _embed_at, layers_with_cache, rope_slice_at
 from ..models.transformer import compute_cast
 from ..parallel.mesh import MODEL_AXIS, PIPE_AXIS
 from ..parallel.pipeline import (_check_tp_divisibility, _dense_layer_specs,
@@ -72,6 +72,43 @@ _HOST_KEYS = ("u", "finished", "emitted", "pos", "prefill_left",
 # their pinned sharding only when dirty, so admission costs one transfer,
 # not a cascade of per-slot jitted updates)
 _SCHED_KEYS = _HOST_KEYS + ("budget", "plen", "live", "prompt_buf")
+# paged mode adds the COW command pair to the per-block fetch (the step
+# returns them cleared, which is exactly the reset the mirrors need) and
+# the page table to the host-writable set
+_PAGED_HOST_KEYS = _HOST_KEYS + ("cow_src", "cow_dst")
+_PAGED_SCHED_KEYS = _PAGED_HOST_KEYS + ("budget", "plen", "live",
+                                        "prompt_buf", "page_tbl")
+
+
+def _paged_cache_apply(cfg: ModelConfig, layers_d, h, kp, vp, pt_row,
+                       offset, s: int, *, tp_axis: Optional[str] = None,
+                       tp_size: int = 1):
+    """Paged twin of :func:`..parallel.pipelined_decode._slot_cache_apply`:
+    gather the slot's pages ``kp[:, pt_row]`` into a positionally-
+    contiguous view (table entry ``i`` holds positions ``[i*ps,
+    (i+1)*ps)``, so gathered row index == absolute position), run the
+    stage's layers, scatter every page back.
+
+    The whole-table scatter is value-safe: a visit only changes rows
+    ``[offset, offset + C)`` and the host allocator guarantees those
+    live in private (refcount == 1) pages — shared prefix pages are
+    rewritten byte-identically, and duplicate null-page entries receive
+    copies of their own unchanged content. The gathered view is longer
+    than the contiguous cache (``P_max * ps >= mlen_alloc``) but the
+    tail is band-masked, and masked scores contribute exact zeros to the
+    softmax, so the paged and contiguous paths are bit-identical (the
+    parity test in tests/test_serving_paging.py pins this)."""
+    lps, n_pages, ps, n_kv, hd = kp.shape
+    pmax = pt_row.shape[0]
+    kg = kp[:, pt_row].reshape(lps, 1, pmax * ps, n_kv, hd)
+    vg = vp[:, pt_row].reshape(lps, 1, pmax * ps, n_kv, hd)
+    rope = rope_slice_at(cfg, pmax * ps, offset, s)
+    h, (kg2, vg2) = layers_with_cache(cfg, layers_d, h, kg, vg, offset,
+                                      rope, tp_axis=tp_axis,
+                                      tp_size=tp_size)
+    kp = kp.at[:, pt_row].set(kg2.reshape(lps, pmax, ps, n_kv, hd))
+    vp = vp.at[:, pt_row].set(vg2.reshape(lps, pmax, ps, n_kv, hd))
+    return h, kp, vp
 
 
 @dataclasses.dataclass
@@ -152,6 +189,19 @@ class ServeResult:
     policy: str
     queue_depth: List[Any] = dataclasses.field(default_factory=list)
     busy_ticks: int = 0
+    # paged-mode gauges (None/empty on contiguous runs): pages_used and
+    # page_fragmentation are (tick, value) series sampled at the same
+    # block boundaries as occupancy; prefix_hit_rate is token-weighted
+    # over all admissions; n_backpressure counts admission attempts
+    # deferred by pool exhaustion (deferred, never failed)
+    paged: bool = False
+    pages_capacity: int = 0
+    pages_used: List[Any] = dataclasses.field(default_factory=list)
+    page_fragmentation: List[Any] = dataclasses.field(default_factory=list)
+    prefix_hit_rate: Optional[float] = None
+    prefill_skipped_tokens: int = 0
+    n_cow: int = 0
+    n_backpressure: int = 0
 
     @property
     def tokens_out(self) -> int:
@@ -206,7 +256,9 @@ class ServingProgram:
     def __init__(self, cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
                  max_len: int, prompt_max: int, out_max: int,
                  prefill_chunk: int, block_ticks: int,
-                 eos_id: Optional[int], step_fn, state_specs) -> None:
+                 eos_id: Optional[int], step_fn, state_specs,
+                 paged: bool = False, page_size: int = 0,
+                 n_pages: int = 0) -> None:
         self.cfg = cfg
         self.mesh = mesh
         self.n_slots = n_slots
@@ -220,6 +272,24 @@ class ServingProgram:
         self.state_specs = state_specs
         self.n_stages = mesh.shape[PIPE_AXIS]
         self.tp = mesh.shape.get(MODEL_AXIS, 1)
+        self.paged = paged
+        self.page_size = page_size
+        self.n_pages = n_pages
+
+    @property
+    def max_pages_per_slot(self) -> int:
+        """Static page-table width: pages to cover ``mlen_alloc`` rows."""
+        if not self.paged:
+            return 0
+        return -(-self.mlen_alloc // self.page_size)
+
+    @property
+    def host_keys(self) -> tuple:
+        return _PAGED_HOST_KEYS if self.paged else _HOST_KEYS
+
+    @property
+    def sched_keys(self) -> tuple:
+        return _PAGED_SCHED_KEYS if self.paged else _SCHED_KEYS
 
     def sharding(self, key: str):
         from jax.sharding import NamedSharding
@@ -245,15 +315,31 @@ class ServingProgram:
         n_kv = cfg.n_kv_heads or cfg.n_heads
         dt = jnp.dtype(cfg.dtype)
         i32 = jnp.int32
+        if self.paged:
+            # the page pool replaces the per-slot contiguous caches; the
+            # [M, P_max] table rides the metadata ring (meta gains P_max
+            # columns), the COW pair is the host's copy command queue
+            pmax = self.max_pages_per_slot
+            cache_shape = (D, lps, self.n_pages, self.page_size, n_kv,
+                           cfg.head_dim)
+            meta_w = 4 + pmax
+            paged_state = {
+                "page_tbl": jnp.zeros((M, pmax), i32),
+                "cow_src": jnp.full((M,), -1, i32),
+                "cow_dst": jnp.full((M,), -1, i32),
+            }
+        else:
+            cache_shape = (D, lps, M, self.mlen_alloc, n_kv, cfg.head_dim)
+            meta_w = 4
+            paged_state = {}
         state = {
             "u": jnp.zeros((), i32),
             "h": jnp.zeros((D, 1, C, cfg.dim), dt),
             "tok_chan": jnp.zeros((D, 1), i32),
-            "meta": jnp.zeros((D, 4), i32),
-            "kc": jnp.zeros((D, lps, M, self.mlen_alloc, n_kv,
-                             cfg.head_dim), dt),
-            "vc": jnp.zeros((D, lps, M, self.mlen_alloc, n_kv,
-                             cfg.head_dim), dt),
+            "meta": jnp.zeros((D, meta_w), i32),
+            "kc": jnp.zeros(cache_shape, dt),
+            "vc": jnp.zeros(cache_shape, dt),
+            **paged_state,
             "tok": jnp.zeros((M,), i32),
             "pos": jnp.zeros((M,), i32),
             "prefill_left": jnp.zeros((M,), i32),
@@ -278,7 +364,9 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
                          max_len: int, prompt_max: int, out_max: int,
                          prefill_chunk: int = 1,
                          block_ticks: Optional[int] = None,
-                         eos_id: Optional[int] = None) -> ServingProgram:
+                         eos_id: Optional[int] = None,
+                         paged: bool = False, page_size: int = 8,
+                         n_pages: Optional[int] = None) -> ServingProgram:
     """Build the serving tick-block program over ``mesh``'s pipe axis.
 
     ``n_slots`` is the ring's M (each slot carries one request);
@@ -288,6 +376,17 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
     many ticks one jitted step advances (default M — every slot visited
     once per block). ``eos_id`` retires a slot the moment it emits that
     token; budget retirement applies always.
+
+    ``paged=True`` swaps the per-slot contiguous caches for a shared
+    page pool ``[n_pages, page_size, Hkv, hd]`` per layer shard plus a
+    static ``[M, P_max]`` int32 page table whose served row rides the
+    metadata ring — every shape stays static, so the block still
+    compiles exactly once. ``n_pages`` *includes* the reserved null
+    page 0 and defaults to full parity capacity (every slot fully
+    backed, ``1 + M * P_max``); size it tighter from an HBM budget with
+    :func:`...analysis.memory_model.size_page_pool` to trade worst-case
+    reservation for admission backpressure (docs/serving.md "Paged KV
+    cache & prefix caching").
     """
     if cfg.arch not in ("gpt2", "llama"):
         raise ValueError(
@@ -329,6 +428,18 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
         raise ValueError(f"block_ticks must be >= 1, got {block}")
     vocab_parallel = tp_axis is not None and cfg.vocab_size % T == 0
     i32 = jnp.int32
+    if paged:
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        pmax = -(-(max_len + C - 1) // page_size)
+        if n_pages is None:
+            n_pages = 1 + M * pmax  # null page + full parity capacity
+        if n_pages < 2:
+            raise ValueError(f"n_pages must be >= 2 (page 0 is the "
+                             f"reserved null page), got {n_pages}")
+    else:
+        pmax = 0
+        n_pages = 0
 
     def spmd(layers_stacked, embed, head, state):
         d = jax.lax.axis_index(PIPE_AXIS)
@@ -386,6 +497,12 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
             off0 = st["pos"][g]
             sf0 = jnp.where(ispre, (pleft <= C).astype(i32), 1)
             meta0 = jnp.stack([off0, sv0, sf0, act0.astype(i32)])
+            if paged:
+                # the served slot's page-table row rides the ring with
+                # the metadata: stages d > 0 gather/scatter through the
+                # copy that arrived with the activations and need no
+                # slot knowledge, exactly like the offset
+                meta0 = jnp.concatenate([meta0, st["page_tbl"][g]])
             meta_eff = jnp.where(is0, meta0, meta)
             offset, s_valid = meta_eff[0], meta_eff[1]
             active = meta_eff[3] == 1
@@ -413,9 +530,14 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
 
             def unit(op):
                 kc, vc = op
-                y, kc, vc = _slot_cache_apply(cfg, layers_d, x, kc, vc, g, 1,
-                                              offset, C, tp_axis=tp_axis,
-                                              tp_size=T)
+                if paged:
+                    y, kc, vc = _paged_cache_apply(cfg, layers_d, x, kc, vc,
+                                                   meta_eff[4:], offset, C,
+                                                   tp_axis=tp_axis, tp_size=T)
+                else:
+                    y, kc, vc = _slot_cache_apply(cfg, layers_d, x, kc, vc,
+                                                  g, 1, offset, C,
+                                                  tp_axis=tp_axis, tp_size=T)
                 y_last = jax.lax.dynamic_slice_in_dim(y, s_valid - 1, 1,
                                                       axis=1)
                 tok = jax.lax.cond(
@@ -439,7 +561,28 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
         inner = dict(state)
         for k in ("h", "tok_chan", "meta", "kc", "vc"):
             inner[k] = state[k][0]
+        if paged:
+            # execute the host's queued copy-on-write commands before any
+            # tick runs: divergence pages become private so the block's
+            # writes never touch a shared (refcount > 1) page. Vectorized
+            # over slots; -1 entries degenerate to rewriting the null
+            # page with its own content. At most one copy per admission.
+            cs, cd = inner["cow_src"], inner["cow_dst"]
+            m = cd > 0
+            ss = jnp.where(m, cs, 0)
+            sd = jnp.where(m, cd, 0)
+            mb = m[None, :, None, None, None]
+            for key in ("kc", "vc"):
+                pool = inner[key]
+                vals = jnp.where(mb, pool[:, ss], pool[:, sd])
+                inner[key] = pool.at[:, sd].set(vals)
         inner, _ = jax.lax.scan(tick, inner, None, length=block)
+        if paged:
+            # the copies ran: return the command pair cleared, so the
+            # host's post-block fetch resets its mirrors and a stale
+            # re-upload can never re-execute a copy over fresh writes
+            inner["cow_src"] = jnp.full((M,), -1, i32)
+            inner["cow_dst"] = jnp.full((M,), -1, i32)
 
         # stage 0's slot tables are authoritative; replicate them so the
         # host (and the next block on every stage) sees one truth
@@ -464,6 +607,11 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
         "budget": P(), "plen": P(), "live": P(), "finished": P(),
         "prompt_buf": P(), "out_buf": P(), "t_first": P(), "t_finish": P(),
     }
+    if paged:
+        # table + COW commands are replicated host-written scalars/rows;
+        # the pool itself reuses the kc/vc cache spec (same rank, the
+        # model axis still shards the n_kv dim)
+        state_spec.update({"page_tbl": P(), "cow_src": P(), "cow_dst": P()})
     sharded = _shard_map(spmd, mesh,
                          in_specs=(layer_spec, P(), P(), state_spec),
                          out_specs=state_spec)
@@ -475,7 +623,9 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
     return ServingProgram(cfg, mesh, n_slots=M, max_len=max_len,
                           prompt_max=prompt_max, out_max=out_max,
                           prefill_chunk=C, block_ticks=block, eos_id=eos_id,
-                          step_fn=step, state_specs=state_spec)
+                          step_fn=step, state_specs=state_spec,
+                          paged=paged, page_size=page_size if paged else 0,
+                          n_pages=n_pages)
 
 
 class ServingEngine:
@@ -498,11 +648,13 @@ class ServingEngine:
     """
 
     def __init__(self, program: ServingProgram, params, *,
-                 report=None, fault_plan=None) -> None:
+                 report=None, fault_plan=None,
+                 prefix_cache: bool = True) -> None:
         self.program = program
         self.weights = program.prepare(params)
         self.report = report
         self.fault_plan = fault_plan
+        self.prefix_cache = prefix_cache
         self.reset()
 
     def reset(self) -> None:
@@ -511,7 +663,7 @@ class ServingEngine:
         # THESE (plain array writes — no per-slot jitted updates to
         # compile), and only dirty keys get re-uploaded before a block
         self.host: Dict[str, np.ndarray] = {
-            k: np.array(self.state[k]) for k in _SCHED_KEYS}
+            k: np.array(self.state[k]) for k in self.program.sched_keys}
         self._dirty: set = set()
         self.pending: deque = deque()
         self.waiting: deque = deque()
@@ -522,6 +674,18 @@ class ServingEngine:
         self._slot_admit: Dict[int, int] = {}
         self._tick = 0
         self._busy_ticks = 0
+        self.paging = None
+        self.pages_used: List[Any] = []
+        self.page_fragmentation: List[Any] = []
+        self._n_backpressure = 0
+        if self.program.paged:
+            from .paging import PagedKVAllocator
+            p = self.program
+            self.paging = PagedKVAllocator(
+                n_pages=p.n_pages, page_size=p.page_size,
+                max_pages_per_slot=p.max_pages_per_slot,
+                prefill_chunk=p.prefill_chunk,
+                prefix_cache=self.prefix_cache)
 
     # -- request intake --------------------------------------------------
 
@@ -545,7 +709,7 @@ class ServingEngine:
 
     # -- scheduling ------------------------------------------------------
 
-    def _admit(self, slot: int, req: Request) -> None:
+    def _admit(self, slot: int, req: Request, plan=None) -> None:
         # plain numpy writes on the host mirrors: per-slot jnp ``.at[]``
         # updates would each compile a one-off XLA program per
         # (field, slot) pair and dominate CPU wall-clock
@@ -554,8 +718,29 @@ class ServingEngine:
         h["prompt_buf"][slot] = 0
         h["prompt_buf"][slot, :plen] = np.asarray(req.prompt, np.int32)
         h["plen"][slot] = plen
-        h["prefill_left"][slot] = plen
-        h["pos"][slot] = 0
+        if plan is not None:
+            # paged admission: map the planned pages, queue the COW copy,
+            # and start the frontier past the cached prefix — prefill for
+            # the matched tokens is skipped outright
+            from ..analysis import maybe_verify_page_table
+            maybe_verify_page_table(
+                plan.pages, refcount=self.paging.pool.refcount,
+                n_pages=p.n_pages, page_size=p.page_size,
+                write_lo=plan.matched_len,
+                write_hi=plen + req.max_new_tokens + p.prefill_chunk - 1,
+                cow_dst=plan.cow_dst)
+            h["page_tbl"][slot] = 0
+            h["page_tbl"][slot, :plan.n_pages] = np.asarray(plan.pages,
+                                                            np.int32)
+            h["cow_src"][slot] = plan.cow_src
+            h["cow_dst"][slot] = plan.cow_dst
+            self._dirty.update(("page_tbl", "cow_src", "cow_dst"))
+            self.paging.bind(slot, plan)
+            h["prefill_left"][slot] = plen - plan.matched_len
+            h["pos"][slot] = plan.matched_len
+        else:
+            h["prefill_left"][slot] = plen
+            h["pos"][slot] = 0
         h["emitted"][slot] = 0
         h["budget"][slot] = req.max_new_tokens
         h["tok"][slot] = 0
@@ -570,11 +755,15 @@ class ServingEngine:
         self._slot_req[slot] = req
         self._slot_admit[slot] = self._tick
         if self.report is not None:
+            paged_kv = ({"matched_len": plan.matched_len,
+                         "n_pages": plan.n_pages}
+                        if plan is not None else {})
             self.report.event("serve_admit", rid=req.rid, slot=slot,
                               tick=self._tick, prompt_len=plen,
                               budget=req.max_new_tokens,
                               arrival=req.arrival,
-                              wait_ticks=self._tick - req.arrival)
+                              wait_ticks=self._tick - req.arrival,
+                              **paged_kv)
 
     def _scrub_slot(self, slot: int) -> None:
         # a failed admission may have left partial mirror writes: park the
@@ -584,6 +773,14 @@ class ServingEngine:
         h["live"][slot] = False
         h["finished"][slot] = False
         self._dirty.update(("live", "finished"))
+        if self.paging is not None:
+            # return the slot's pages uncached and cancel any queued COW
+            # (the copy must never run into a page that just went free)
+            self.paging.release(slot)
+            h["page_tbl"][slot] = 0
+            h["cow_src"][slot] = -1
+            h["cow_dst"][slot] = -1
+            self._dirty.update(("page_tbl", "cow_src", "cow_dst"))
         self._slot_req.pop(slot, None)
         self._slot_admit.pop(slot, None)
 
@@ -614,6 +811,14 @@ class ServingEngine:
             self.completions.append(comp)
             host["live"][slot] = False
             self._dirty.add("live")
+            if self.paging is not None:
+                # decref the slot's pages and cache the prompt-covered
+                # ones for future prefix hits; clear the stale table row
+                # (a dead slot's row is never gathered, but a zeroed row
+                # keeps the page-table discipline check trivially green)
+                self.paging.retire(slot, req.prompt)
+                host["page_tbl"][slot] = 0
+                self._dirty.add("page_tbl")
             del self._slot_req[slot]
             del self._slot_admit[slot]
             if self.report is not None:
@@ -653,7 +858,39 @@ class ServingEngine:
                 self.waiting.append(self.pending.popleft())
             if policy == "continuous" or len(free) == p.n_slots:
                 while free and self.waiting:
-                    req = self.waiting.popleft()
+                    req = self.waiting[0]
+                    plan = None
+                    if self.paging is not None:
+                        if not self.paging.admissible(len(req.prompt),
+                                                      req.max_new_tokens):
+                            # needs more pages than the pool has: no
+                            # amount of waiting fixes it — per-request
+                            # failure, not backpressure
+                            self.waiting.popleft()
+                            self._fail_request(
+                                req, f"request needs "
+                                f"{self.paging.pages_needed(len(req.prompt), req.max_new_tokens)} "
+                                f"pages but the pool holds "
+                                f"{self.paging.pool.capacity}")
+                            continue
+                        plan = self.paging.try_admit(req.prompt,
+                                                     req.max_new_tokens)
+                        if plan is None:
+                            # pool exhausted: backpressure. The request
+                            # stays at the head of the queue; if slots
+                            # are active the block below retires them
+                            # and frees pages. With nothing active every
+                            # page is trie-held and evictable, so
+                            # try_admit cannot fail — defend anyway.
+                            self._n_backpressure += 1
+                            if not self._slot_req:
+                                self.waiting.popleft()
+                                self._fail_request(
+                                    req, "page pool exhausted with no "
+                                    "active slots to retire")
+                                continue
+                            break
+                    self.waiting.popleft()
                     slot = free[0]
                     try:
                         if req.rid in poison:
@@ -661,11 +898,16 @@ class ServingEngine:
                             raise SimulatedFault(
                                 f"injected admission fault for rid "
                                 f"{req.rid}")
-                        self._admit(slot, req)
+                        self._admit(slot, req, plan)
                     except Exception as e:  # noqa: BLE001 — quarantine,
                         # retire the request, keep the slot free and the
                         # ring serving (wedging all slots is the failure
                         # mode this loop exists to prevent)
+                        if (plan is not None
+                                and self.paging.plan_for(slot) is not plan):
+                            # admission died before the slot bound the
+                            # plan: return its pages directly
+                            self.paging.release_plan(plan)
                         self._scrub_slot(slot)
                         self._fail_request(req, f"admission failed: {e}")
                         continue
@@ -688,6 +930,11 @@ class ServingEngine:
                     self._dirty.add("u")
                     self.occupancy.append((self._tick, 0))
                     self.queue_depth.append((self._tick, 0))
+                    if self.paging is not None:
+                        # pages may still be trie-held across an idle gap
+                        self.pages_used.append(
+                            (self._tick, self.paging.pages_used))
+                        self.page_fragmentation.append((self._tick, 0.0))
                     continue
             # upload only the leaves the scheduler touched, in one batched
             # transfer, each pinned to its spec so the jitted block sees
@@ -700,9 +947,15 @@ class ServingEngine:
                 self._dirty.clear()
             tick_before = self._tick
             self.state = p.step(*self.weights, self.state)
-            fetched = jax.device_get({k: self.state[k] for k in _HOST_KEYS})
+            fetched = jax.device_get({k: self.state[k]
+                                      for k in p.host_keys})
             self.host.update(  # np.array: device_get views can be read-only
                 {k: np.array(v) for k, v in fetched.items()})
+            if self.paging is not None:
+                # the block executed any queued COW copies (and the fetch
+                # above reset the cow mirrors to the cleared -1s): the
+                # source pages no longer need their safety hold
+                self.paging.cow_flush()
             self._tick = int(self.host["u"])
             # every executed block had >= 1 live slot at entry (the empty
             # cases break or fast-forward above), so its ticks are busy
@@ -718,17 +971,35 @@ class ServingEngine:
                     break
                 n_wait += 1
             self.queue_depth.append((self._tick, n_wait))
+            if self.paging is not None:
+                self.pages_used.append((self._tick, self.paging.pages_used))
+                frontier = {s: int(self.host["pos"][s])
+                            for s in self._slot_req}
+                self.page_fragmentation.append(
+                    (self._tick,
+                     round(self.paging.fragmentation(frontier), 6)))
             self._harvest()
             free = [g for g in range(p.n_slots) if g not in self._slot_req]
         else:
             raise RuntimeError(f"serving did not drain within {max_blocks} "
                                "blocks — check arrivals/budgets")
         wall = time.perf_counter() - wall0
+        paged_kv: Dict[str, Any] = {}
+        if self.paging is not None:
+            self.paging.cow_flush()  # a scrubbed final admission's hold
+            paged_kv = dict(
+                paged=True, pages_capacity=self.paging.pool.capacity,
+                pages_used=self.pages_used,
+                page_fragmentation=self.page_fragmentation,
+                prefix_hit_rate=round(self.paging.prefix_hit_rate(), 6),
+                prefill_skipped_tokens=self.paging.matched_tokens,
+                n_cow=self.paging.n_cow,
+                n_backpressure=self._n_backpressure)
         result = ServeResult(completions=self.completions,
                              occupancy=self.occupancy, ticks=self._tick,
                              wall_s=wall, n_slots=p.n_slots, policy=policy,
                              queue_depth=self.queue_depth,
-                             busy_ticks=self._busy_ticks)
+                             busy_ticks=self._busy_ticks, **paged_kv)
         if self.report is not None:
             # one event per run with the measured tick rate — the factor
             # the cost model's predicted per-tick time reconciles against
@@ -737,5 +1008,9 @@ class ServingEngine:
                 busy_ticks=result.busy_ticks,
                 wall_s=round(wall, 4), tokens_out=result.tokens_out,
                 s_per_tick=(round(wall / result.ticks, 6)
-                            if result.ticks else None))
+                            if result.ticks else None),
+                **({"prefix_hit_rate": result.prefix_hit_rate,
+                    "n_backpressure": result.n_backpressure,
+                    "n_cow": result.n_cow} if self.paging is not None
+                   else {}))
         return result

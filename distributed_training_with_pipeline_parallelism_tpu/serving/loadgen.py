@@ -46,6 +46,14 @@ WORKLOAD_MIXES: Dict[str, Dict[str, Any]] = {
     "long_doc": {"prompt_lens": (8, 12), "out_lens": (2, 6)},
     "mixed": {"components": ("short_chat", "long_doc"),
               "fractions": (0.5, 0.5)},
+    # shared-system-prompt traffic: every request is a short_chat request
+    # with one of ``n_prefixes`` seeded shared prefixes prepended — the
+    # deterministic workload that exercises radix prefix reuse
+    # (ISSUE 19). Offered load stays normalized to the BASE stream's
+    # capacity: the prefix rows are exactly the repeated prefill work a
+    # prefix cache skips, so the paged engine's goodput win on this mix
+    # is the sharing win, measured not assumed.
+    "prefix": {"base": "short_chat", "n_prefixes": 2, "prefix_len": 6},
 }
 
 
@@ -84,6 +92,26 @@ def make_workload(n_requests: int, mix: str = "mixed", *,
         raise ValueError(f"unknown workload mix {mix!r} "
                          f"(have: {sorted(table)})")
     spec = table[mix]
+    if "base" in spec:
+        # prefix mix: the base stream's trace (same seed discipline, so
+        # arrivals/budgets are ramp-stable) with a seeded shared prefix
+        # prepended to every prompt. Prefix tokens and the per-request
+        # prefix choice derive from ``seed``, so identical across ramp
+        # points and across processes.
+        base = make_workload(n_requests, spec["base"],
+                             prefill_chunk=prefill_chunk, load=load,
+                             vocab_size=vocab_size, seed=seed, mixes=table)
+        rs = np.random.RandomState(seed + 104729)
+        n_pre, pre_len = int(spec["n_prefixes"]), int(spec["prefix_len"])
+        prefixes = [[int(t) for t in rs.randint(1, vocab_size,
+                                                size=pre_len)]
+                    for _ in range(n_pre)]
+        choices = rs.randint(0, n_pre, size=len(base))
+        return [Request(rid=r.rid,
+                        prompt=prefixes[int(choices[i])] + list(r.prompt),
+                        max_new_tokens=r.max_new_tokens,
+                        arrival=r.arrival)
+                for i, r in enumerate(base)]
     if "components" not in spec:
         return synth_trace(n_requests, prompt_lens=spec["prompt_lens"],
                            out_lens=spec["out_lens"],
@@ -146,6 +174,14 @@ def _point_row(load: float, summary: Dict[str, Any],
             if predicted_s_per_tick and measured else None),
         "summary": summary,
     }
+    # paged-engine gauges surface as first-class curve columns (absent
+    # on contiguous runs, so regress/plot consumers can tell the modes
+    # apart by presence)
+    for key in ("prefix_hit_rate", "pages_used_mean", "pages_used_max",
+                "pages_capacity", "page_fragmentation_mean",
+                "prefill_skipped_tokens", "n_cow", "n_backpressure"):
+        if summary.get(key) is not None:
+            row[key] = summary[key]
     if slo_point is not None:
         row["slo"] = slo_point
     return row

@@ -684,6 +684,8 @@ def perfetto_serving_load_events(serving_events: List[Dict[str, Any]],
                                  occupancy: Optional[List[Any]] = None,
                                  queue_depth: Optional[List[Any]] = None,
                                  s_per_tick: Optional[float] = None,
+                                 pages_used: Optional[List[Any]] = None,
+                                 page_fragmentation: Optional[List[Any]] = None,
                                  pid: int = 3) -> List[Dict[str, Any]]:
     """The serving-load debugging surface on the **tick clock**: per-slot
     request slices split into *queue wait* vs *execution* sub-spans, plus
@@ -702,7 +704,11 @@ def perfetto_serving_load_events(serving_events: List[Dict[str, Any]],
     ``s_per_tick`` scales ticks to real time when known (1 tick = 1 us
     otherwise — relative layout is what matters). Admit rows without an
     ``arrival`` field (pre-SLO-observatory streams) degrade to a
-    zero-width wait slice."""
+    zero-width wait slice. Paged-engine runs add ``pages used`` and
+    ``page fragmentation`` counter tracks from the same block-boundary
+    samples (``ServeResult.pages_used``/``.page_fragmentation``), so a
+    TTFT blow-up under prefix traffic decomposes into queue pressure vs
+    page-pool pressure on one screen."""
     admits: Dict[Any, Dict[str, Any]] = {}
     finishes: Dict[Any, Dict[str, Any]] = {}
     for row in serving_events or []:
@@ -710,7 +716,7 @@ def perfetto_serving_load_events(serving_events: List[Dict[str, Any]],
             admits[row["rid"]] = row
         elif row.get("kind") == "serve_finish" and "rid" in row:
             finishes[row["rid"]] = row
-    if not admits and not occupancy and not queue_depth:
+    if not admits and not occupancy and not queue_depth and not pages_used:
         return []
     tick_us = (s_per_tick * 1e6) if s_per_tick else 1.0
     out: List[Dict[str, Any]] = [{
@@ -753,11 +759,17 @@ def perfetto_serving_load_events(serving_events: List[Dict[str, Any]],
                     "dur": max(end_tick - admit_tick, 0.0) * tick_us,
                     "args": fargs})
     for name, series in (("slot occupancy", occupancy),
-                         ("queue depth", queue_depth)):
+                         ("queue depth", queue_depth),
+                         ("pages used", pages_used)):
         for t, n in series or []:
             out.append({"ph": "C", "name": name, "cat": "serving_load",
                         "pid": pid, "tid": 0, "ts": float(t) * tick_us,
                         "args": {name.replace(" ", "_"): int(n)}})
+    for t, f in page_fragmentation or []:
+        out.append({"ph": "C", "name": "page fragmentation",
+                    "cat": "serving_load", "pid": pid, "tid": 0,
+                    "ts": float(t) * tick_us,
+                    "args": {"page_fragmentation": float(f)}})
     return out
 
 
@@ -836,7 +848,10 @@ def write_perfetto_trace(telemetry: Optional[PipelineTelemetry], path: str,
             serving_events or [],
             occupancy=serving_load_tracks.get("occupancy"),
             queue_depth=serving_load_tracks.get("queue_depth"),
-            s_per_tick=serving_load_tracks.get("s_per_tick")))
+            s_per_tick=serving_load_tracks.get("s_per_tick"),
+            pages_used=serving_load_tracks.get("pages_used"),
+            page_fragmentation=serving_load_tracks.get(
+                "page_fragmentation")))
     with open(path, "w") as fh:
         json.dump(trace, fh)
     return path
@@ -911,6 +926,32 @@ def serving_summary(result) -> Dict[str, Any]:
         "queue_depth_mean": float(np.mean(qd)) if qd else 0.0,
         "queue_depth_max": int(max(qd)) if qd else 0,
         "queue_depth": [[int(t), int(n)] for t, n in qd_series],
+        **_paged_summary_fields(result),
+    }
+
+
+def _paged_summary_fields(result) -> Dict[str, Any]:
+    """Paged-KV gauges for :func:`serving_summary` — empty dict for
+    contiguous runs, so their summaries are byte-identical to before the
+    paged engine existed."""
+    if not getattr(result, "paged", False):
+        return {}
+    pages = [int(n) for _, n in (result.pages_used or [])]
+    frag = [float(f) for _, f in (result.page_fragmentation or [])]
+    return {
+        "paged": True,
+        "pages_capacity": int(result.pages_capacity),
+        "pages_used_mean": float(np.mean(pages)) if pages else 0.0,
+        "pages_used_max": int(max(pages)) if pages else 0,
+        "pages_used": [[int(t), int(n)] for t, n in result.pages_used],
+        "page_fragmentation_mean": (float(np.mean(frag)) if frag else 0.0),
+        "page_fragmentation": [[int(t), float(f)]
+                               for t, f in result.page_fragmentation],
+        "prefix_hit_rate": (float(result.prefix_hit_rate)
+                            if result.prefix_hit_rate is not None else 0.0),
+        "prefill_skipped_tokens": int(result.prefill_skipped_tokens),
+        "n_cow": int(result.n_cow),
+        "n_backpressure": int(result.n_backpressure),
     }
 
 
@@ -1252,10 +1293,18 @@ def validate_report(manifest: Dict[str, Any]) -> None:
                         pct["p99"], (int, float)):
                     fail(f"serving_load curve row {key}.p99 must be a "
                          "number or null")
-            for key in ("goodput", "queue_depth_mean"):
+            # paged-engine gauge columns are optional (contiguous runs
+            # omit them) but typed when present
+            for key in ("goodput", "queue_depth_mean", "prefix_hit_rate",
+                        "pages_used_mean", "page_fragmentation_mean"):
                 if key in row and row[key] is not None and not isinstance(
                         row[key], (int, float)):
                     fail(f"serving_load curve row {key!r} must be numeric")
+            for key in ("pages_capacity", "pages_used_max", "n_cow",
+                        "n_backpressure", "prefill_skipped_tokens"):
+                if key in row and row[key] is not None and not isinstance(
+                        row[key], int):
+                    fail(f"serving_load curve row {key!r} must be an int")
         if any(b <= a for a, b in zip(loads, loads[1:])):
             fail(f"serving_load offered loads must be strictly "
                  f"increasing, got {loads}")

@@ -22,7 +22,12 @@ the same (name, backend, schedule) group:
   (p99 TTFT in ticks at the sweep's reference load) rises by more than
   the threshold — the serving SLO guard: a scheduler change that moves
   the knee left or inflates uncontended tail latency fails here before
-  a deployment notices,
+  a deployment notices. Paged-KV runs add ``prefix_hit_rate`` (drop by
+  more than the threshold) to the same guard: a radix-cache or
+  admission change that quietly stops sharing prefixes fails here even
+  while correctness tests still pass (the hit rate is deterministic on
+  the seeded prefix mix, so off-cpu it gates hard; cpu-proxy stays
+  warn-only like everything else),
 - ``overlap_tokens_per_sec`` (bench's ``overlap_on`` pair row — the
   double-buffered ring executor, docs/performance.md "Comm/compute
   overlap") drops by more than the threshold: a change that silently
@@ -120,6 +125,7 @@ def extract_metrics(manifest) -> dict:
             "n_skipped_attributed": None,
             "max_sustainable_load": None,
             "serve_ttft_p99_ref": None,
+            "prefix_hit_rate": None,
             "overlap_tokens_per_sec": None,
             "rel_err": None,
             "abs_rel_err": None,
@@ -166,6 +172,25 @@ def extract_metrics(manifest) -> dict:
     sl = manifest.get("serving_load")
     max_sustainable = _num(_get(sl, "knee", "max_sustainable_load"))
     ttft_ref = _num(_get(sl, "reference", "ttft_p99_ticks"))
+    # paged-KV sharing gauge: best hit rate across the sweep's curve
+    # rows (deterministic on a seeded mix), falling back to the serving
+    # summaries / gauges for single-point bench reports. None on
+    # contiguous runs -> no prior -> never gated.
+    prefix_hit = None
+    if isinstance(sl, dict):
+        for r in sl.get("curve") or []:
+            v = _num(r.get("prefix_hit_rate")) if isinstance(r, dict) \
+                else None
+            if v is not None:
+                prefix_hit = v if prefix_hit is None else max(prefix_hit, v)
+    if prefix_hit is None:
+        for r in manifest.get("serving") or []:
+            v = _num(r.get("prefix_hit_rate")) if isinstance(r, dict) \
+                else None
+            if v is not None:
+                prefix_hit = v
+    if prefix_hit is None:
+        prefix_hit = _num(gauges.get("prefix_hit_rate"))
     # comm/compute overlap pair (bench.py): the overlap-on throughput is
     # guarded like the headline; on a cpu-proxy backend all throughput
     # gates are already warn-only, so the jittery serialized-tick number
@@ -204,6 +229,7 @@ def extract_metrics(manifest) -> dict:
                                  else None),
         "max_sustainable_load": max_sustainable,
         "serve_ttft_p99_ref": ttft_ref,
+        "prefix_hit_rate": prefix_hit,
         "overlap_tokens_per_sec": overlap_tps,
         "rel_err": rel_err,
         "abs_rel_err": abs(rel_err) if rel_err is not None else None,
@@ -259,6 +285,7 @@ def check(row, history, threshold, window) -> list:
                            ("peak_live_bytes", "up"),
                            ("max_sustainable_load", "down"),
                            ("serve_ttft_p99_ref", "up"),
+                           ("prefix_hit_rate", "down"),
                            ("overlap_tokens_per_sec", "down"),
                            # model-trust guards: prediction error may not
                            # quietly grow (missing in pre-calibration

@@ -26,10 +26,23 @@ tracks) into the output directory (argv[1], default
 ``/tmp/serve_load``). Exits 0 on success, 1 with a reason on any
 violation. One compile; target well under two minutes on a CI host.
 
+``--paged`` runs the same observatory through the paged-KV engine
+(page-pool + radix prefix cache; pair it with ``--mix prefix`` for the
+shared-prefix traffic the cache exists for): the curve rows grow the
+page gauges (prefix hit rate, pages used, fragmentation, backpressure),
+the report name becomes ``serve_load_paged`` so the regression history
+groups paged and contiguous knees separately, and the Perfetto trace
+gains page-pool counter tracks. Before building anything the pool
+config is priced by ``oom_preflight``; an over-budget pool writes a
+``skip_reason="predicted_oom"`` row to ``curve.json`` and exits 0
+instead of compiling (``--n-pages`` overrides the default
+full-capacity pool; ``--headroom`` tightens the budget).
+
 Usage::
 
     python scripts/serve_load.py [OUT_DIR] [--loads 0.4,0.8,1.2]
         [--n-requests 24] [--mix mixed] [--seed 0]
+        [--paged] [--page-size 4] [--n-pages N] [--headroom 1.0]
 """
 
 import argparse
@@ -62,6 +75,17 @@ def main(argv=None) -> int:
     ap.add_argument("--n-requests", type=int, default=24)
     ap.add_argument("--mix", default="mixed")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--paged", action="store_true",
+                    help="run the paged-KV engine (page pool + radix "
+                         "prefix cache) instead of contiguous slots")
+    ap.add_argument("--page-size", type=int, default=4)
+    ap.add_argument("--n-pages", type=int, default=None,
+                    help="pool size (default: full contiguous-parity "
+                         "capacity); priced by oom_preflight before "
+                         "any compile")
+    ap.add_argument("--headroom", type=float, default=1.0,
+                    help="fraction of detected HBM the preflight may "
+                         "budget (paged only)")
     args = ap.parse_args(argv)
     out_dir = args.out_dir
     loads = [float(x) for x in args.loads.split(",")]
@@ -86,15 +110,42 @@ def main(argv=None) -> int:
                            arch="gpt2")
     params = tfm.transformer_init(jax.random.key(0), cfg)
     mesh = make_mesh(n_pipe=2)
+    paged_kw = ({"paged": True, "page_size": args.page_size}
+                if args.paged else {})
+    if args.paged and args.n_pages is not None:
+        paged_kw["n_pages"] = args.n_pages
     program = make_serving_step_fn(cfg, mesh, n_slots=3, max_len=48,
                                    prompt_max=12, out_max=16,
-                                   prefill_chunk=prefill_chunk, eos_id=None)
-    report = RunReport(out_dir=out_dir, name="serve_load")
+                                   prefill_chunk=prefill_chunk, eos_id=None,
+                                   **paged_kw)
+    name = "serve_load_paged" if args.paged else "serve_load"
+    report = RunReport(out_dir=out_dir, name=name)
     report.set_meta(config=cfg, mesh_shape=dict(mesh.shape),
                     backend=jax.devices()[0].platform,
                     n_slots=3, prefill_chunk=prefill_chunk,
                     loads=loads, mix=args.mix, n_requests=args.n_requests,
-                    seed=args.seed)
+                    seed=args.seed, paged=args.paged)
+    if args.paged:
+        # price the pool BEFORE compiling anything: an over-budget page
+        # pool becomes a skip row, not an OOM mid-ramp (building the
+        # program is lazy — no trace has happened yet)
+        from distributed_training_with_pipeline_parallelism_tpu.analysis.memory_model import (  # noqa: E501
+            oom_preflight, serving_memory_section)
+        pf = oom_preflight(serving_memory_section(cfg, program),
+                           headroom=args.headroom)
+        if not pf["ok"]:
+            os.makedirs(out_dir, exist_ok=True)
+            row = {"skip_reason": "predicted_oom", **pf,
+                   "n_pages": int(program.n_pages),
+                   "page_size": int(program.page_size)}
+            with open(os.path.join(out_dir, "curve.json"), "w") as fh:
+                json.dump(row, fh, indent=1)
+            print(f"serve_load: SKIPPED (predicted_oom): "
+                  f"{program.n_pages}-page pool prices at "
+                  f"{pf['predicted_peak_bytes']:.3g} B/device vs "
+                  f"{pf['hbm_bytes']:.3g} x {args.headroom} HBM — "
+                  f"skip row at {os.path.join(out_dir, 'curve.json')}")
+            return 0
     engine = ServingEngine(program, params, report=report)
 
     section = sweep_offered_load(engine, loads, mix=args.mix,
@@ -153,13 +204,22 @@ def main(argv=None) -> int:
         serving_events=report.events,
         serving_load_tracks={"occupancy": last.get("occupancy"),
                              "queue_depth": last.get("queue_depth"),
-                             "s_per_tick": last.get("s_per_tick")})
+                             "s_per_tick": last.get("s_per_tick"),
+                             "pages_used": last.get("pages_used"),
+                             "page_fragmentation":
+                                 last.get("page_fragmentation")})
 
+    paged_note = ""
+    if args.paged:
+        hit = section["curve"][-1].get("prefix_hit_rate")
+        paged_note = (f", paged ({program.n_pages} pages x "
+                      f"{program.page_size}), prefix hit rate {hit}")
     print(f"serve_load: OK — ramp {loads} ({args.mix}, "
           f"{args.n_requests} req/point), knee at {knee['knee_load']} "
           f"({knee['reason']}), max sustainable "
           f"{knee['max_sustainable_load']}, p99 TTFT {p99s} ticks, "
-          f"1 compile; report at {os.path.join(out_dir, 'report.json')}; "
+          f"1 compile{paged_note}; report at "
+          f"{os.path.join(out_dir, 'report.json')}; "
           f"curve at {curve_path}; trace at {trace_path}")
     return 0
 

@@ -10,13 +10,22 @@ artifact): drive a small request mix through the slot-level
   recycled slots included),
 - the static fill-drain policy emits the same per-request tokens and
   needs at least as many ticks as continuous,
+- the paged-KV engine (page-pool gather + host-side radix/COW
+  admission, same geometry) emits tokens bit-identical to the
+  contiguous run on one compiled block,
+- the paged-vs-contiguous comparison at a matched per-device HBM
+  budget (``run_paged_bench`` on the shared-prefix mix) admits at
+  least as many slots, matches completions across engines, and shows a
+  nonzero prefix hit rate — the ISSUE 19 headline, uploaded as
+  ``paged_compare.json``,
 - a ``RunReport`` manifest with a populated ``serving`` section (TTFT /
   TPOT percentiles) that passes ``validate_report``.
 
-Writes ``report.json`` (+ ``events.jsonl``) into the output directory
-(argv[1], default ``/tmp/serve_smoke``) and exits 0 on success, 1 with
-a reason on any violation. Two small compiles (serving block + oracle):
-target well under a minute on a CI host.
+Writes ``report.json`` (+ ``events.jsonl``) and ``paged_compare.json``
+into the output directory (argv[1], default ``/tmp/serve_smoke``) and
+exits 0 on success, 1 with a reason on any violation. Five small
+compiles (contiguous + paged serving blocks, oracle, the comparison's
+two engines): target a couple of minutes on a CI host.
 """
 
 import os
@@ -106,6 +115,55 @@ def main() -> int:
         return 1
     report.attach_serving(serving_summary(static))
 
+    # paged-KV parity: the page-pool engine on the same geometry must be
+    # bit-identical to the contiguous run (the gather through the page
+    # table reconstructs exactly the contiguous per-slot view)
+    paged_prog = make_serving_step_fn(cfg, mesh, n_slots=3, max_len=32,
+                                      prompt_max=8, out_max=10,
+                                      prefill_chunk=2, eos_id=EOS,
+                                      paged=True, page_size=4)
+    paged_engine = ServingEngine(paged_prog, params, report=report)
+    paged_res = paged_engine.run(requests, policy="continuous")
+    cont_by_rid = {c.rid: c.tokens for c in res.completions}
+    if any(cont_by_rid.get(c.rid) != c.tokens
+           for c in paged_res.completions):
+        print("serve_smoke: paged engine emitted different tokens than "
+              "contiguous", file=sys.stderr)
+        return 1
+    if paged_prog.step._cache_size() != 1:
+        print(f"serve_smoke: paged block compiled "
+              f"{paged_prog.step._cache_size()}x (want 1)", file=sys.stderr)
+        return 1
+    paged_engine.paging.check_invariants()  # raises on any page leak
+    report.attach_serving(serving_summary(paged_res))
+
+    # the ISSUE 19 headline: paged vs contiguous at a matched HBM budget
+    # on the shared-prefix mix, reusing this smoke's weights (two more
+    # small compiles); the row is the CI artifact regress/plot consumers
+    # read
+    from distributed_training_with_pipeline_parallelism_tpu.serving.bench import (
+        run_paged_bench)
+    compare = run_paged_bench(cfg=cfg, params=params, mesh=mesh,
+                              n_slots=4, max_len=32, prompt_max=12,
+                              out_max=16, page_size=4, n_requests=12,
+                              load=1.2, seed=0)
+    if not compare["outputs_match"]:
+        print("serve_smoke: paged-vs-contiguous completions diverged at "
+              "matched budget", file=sys.stderr)
+        return 1
+    if compare["paged_slots"] < compare["contiguous_slots"]:
+        print(f"serve_smoke: paged admitted fewer slots "
+              f"({compare['paged_slots']} < {compare['contiguous_slots']}) "
+              f"at the same budget", file=sys.stderr)
+        return 1
+    if not compare["prefix_hit_rate"]:
+        print("serve_smoke: zero prefix hit rate on the prefix mix",
+              file=sys.stderr)
+        return 1
+    report.gauge("prefix_hit_rate", compare["prefix_hit_rate"])
+    report.gauge("paged_slot_gain", compare["slot_gain"])
+    report.gauge("paged_goodput_gain", compare["goodput_gain"])
+
     # memory observatory: analytic KV/params accounting + XLA's numbers
     # for the already-compiled serving block (docs/observability.md)
     from distributed_training_with_pipeline_parallelism_tpu.analysis.memory_model import (
@@ -120,8 +178,12 @@ def main() -> int:
     manifest = report.write()
     validate_report(manifest)  # write() validates too; belt and suspenders
     rows = manifest.get("serving", [])
-    if len(rows) != 2 or rows[0]["ttft_ticks"]["p50"] is None:
+    if len(rows) != 3 or rows[0]["ttft_ticks"]["p50"] is None:
         print("serve_smoke: serving section missing or empty",
+              file=sys.stderr)
+        return 1
+    if not rows[2].get("paged") or "prefix_hit_rate" not in rows[2]:
+        print("serve_smoke: paged serving row lost its page gauges",
               file=sys.stderr)
         return 1
     if "memory" not in manifest or not manifest["memory"]["analytic"].get(
@@ -138,6 +200,10 @@ def main() -> int:
         None, os.path.join(out_dir, "requests_trace.json"),
         serving_events=report.events)
     import json
+
+    compare_path = os.path.join(out_dir, "paged_compare.json")
+    with open(compare_path, "w") as fh:
+        json.dump(compare, fh, indent=1)
     with open(trace_path) as fh:
         tr = json.load(fh)
     n_b = sum(1 for e in tr["traceEvents"] if e.get("ph") == "b")
@@ -148,8 +214,12 @@ def main() -> int:
 
     print(f"serve_smoke: OK — {len(requests)} requests bit-matched the "
           f"oracle; continuous {res.ticks} ticks vs static {static.ticks}; "
-          f"report at {os.path.join(out_dir, 'report.json')}; request "
-          f"spans at {trace_path}")
+          f"paged bit-matched contiguous; matched-budget comparison "
+          f"{compare['paged_slots']} vs {compare['contiguous_slots']} "
+          f"slots, prefix hit rate {compare['prefix_hit_rate']:.3f} "
+          f"({compare_path}); report at "
+          f"{os.path.join(out_dir, 'report.json')}; request spans at "
+          f"{trace_path}")
     return 0
 
 

@@ -124,6 +124,26 @@ if ! timeout -k 10 60 \
   exit 1
 fi
 echo "SERVE_LOAD=ok"
+# Paged-KV SLO leg (ISSUE 19): the same observatory through the paged
+# engine on the shared-prefix mix — page-pool gather, radix prefix
+# cache, COW sharing. A taller ramp because prefix reuse genuinely
+# raises sustainable load (that is the point); the knee, prefix hit
+# rate, and sustainable load feed the regression history under the
+# separate serve_load_paged group so a sharing regression trips the
+# sentinel (docs/serving.md "Paged KV cache & prefix caching").
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python scripts/serve_load.py /tmp/serve_load_paged \
+    --paged --mix prefix --loads 0.4,0.8,1.2,1.8,2.6; then
+  echo "SERVE_LOAD_PAGED=fail"
+  exit 1
+fi
+if ! timeout -k 10 60 \
+    python scripts/regress.py --report /tmp/serve_load_paged/report.json \
+    --history results/history.jsonl --warn-only; then
+  echo "SERVE_LOAD_PAGED=fail"
+  exit 1
+fi
+echo "SERVE_LOAD_PAGED=ok"
 # Comm/compute overlap leg (own budget): the overlap grid check prices
 # every registered schedule in the cost model's comm_overlap mode and
 # pins the step_s_overlapped <= step_s_comm_overlap <= step_s sandwich

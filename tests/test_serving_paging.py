@@ -1,0 +1,394 @@
+"""Paged KV cache with radix prefix reuse (ISSUE 19): page-pool
+allocation, COW sharing, and the paged serving engine.
+
+The load-bearing properties, in order of strength:
+
+- BIT PARITY: the paged engine's gather through the page table must
+  reconstruct exactly the contiguous per-slot cache view, so on a
+  workload with no shared prefixes its greedy tokens bit-match the
+  contiguous engine (and therefore the single-device oracle the
+  contiguous engine is already pinned to), on gpt2 pipe-only and
+  llama TP x PP meshes alike.
+- SHARING IS INVISIBLE: on a shared-prefix workload the radix cache
+  serves prompt pages it populated earlier (refcount > 1, COW on
+  divergence) and completions still match the contiguous engine —
+  cached prefix KV is bitwise the KV that recomputation would produce.
+- EXHAUSTION IS BACKPRESSURE: a pool too small for the offered
+  concurrency defers admissions (``n_backpressure > 0``) but NEVER
+  fails a request (``n_failed == 0``); only a request that could never
+  fit the pool fails, immediately and with a reason.
+- ACCOUNTING CLOSES: after a drained run every live page is the null
+  page or a refcount-1 radix entry (``check_invariants``), and the
+  one-compilation invariant holds despite the host-side admission
+  machinery.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.analysis.table_check import (
+    check_serving_ring, page_table_hazards)
+from distributed_training_with_pipeline_parallelism_tpu.models import (
+    transformer as tfm)
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+    make_mesh)
+from distributed_training_with_pipeline_parallelism_tpu.serving import (
+    Request, ServingEngine, make_serving_step_fn)
+from distributed_training_with_pipeline_parallelism_tpu.serving.paging import (
+    PagePool, PagedKVAllocator, RadixPrefixCache, pages_for)
+
+EOS = 7
+
+
+def _cfg(**kw):
+    base = dict(dim=32, n_layers=4, n_heads=4, vocab_size=64, ffn_dim=64,
+                max_seq_len=64, arch="gpt2")
+    base.update(kw)
+    return dtpp.ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def gpt2():
+    cfg = _cfg()
+    return cfg, tfm.transformer_init(jax.random.key(0), cfg)
+
+
+# ---------------------------------------------------------------------------
+# Host-side allocator units (no jax, no compiles)
+# ---------------------------------------------------------------------------
+
+
+def test_pages_for():
+    assert pages_for(0, 4) == 0
+    assert pages_for(1, 4) == 1
+    assert pages_for(4, 4) == 1
+    assert pages_for(5, 4) == 2
+
+
+def test_page_pool_refcount_accounting():
+    pool = PagePool(n_pages=6, page_size=4)
+    assert pool.capacity == 5 and pool.n_free == 5  # page 0 reserved
+    a = pool.alloc(3)
+    assert len(a) == 3 and 0 not in a
+    assert pool.n_used == 3
+    pool.incref(a[0])
+    assert not pool.decref(a[0])  # still shared
+    assert pool.decref(a[0])  # now freed
+    assert pool.decref(a[1]) and pool.decref(a[2])
+    assert pool.n_free == 5
+    assert pool.alloc(6) is None  # over capacity -> whole alloc refused
+    assert pool.n_free == 5  # refused alloc leaks nothing
+
+
+def test_radix_cache_match_insert_evict():
+    pool = PagePool(n_pages=10, page_size=2)
+    cache = RadixPrefixCache(pool)
+    prompt = [5, 6, 7, 8, 9]
+    pages = pool.alloc(3)  # covers plen=5 at ps=2 (last page partial)
+    cache.insert(prompt, len(prompt), pages)
+    # only fully-prompt-covered pages are cached: floor(5/2) = 2 chunks
+    assert cache.match(prompt) == pages[:2]
+    assert cache.match([5, 6, 99]) == pages[:1]  # diverges in chunk 2
+    assert cache.match([1, 2, 3]) == []
+    # retire the slot's own references (as release_plan would): cached
+    # pages drop to the cache's refcount 1, the uncached tail page frees
+    for pg in pages:
+        pool.decref(pg)
+    assert pool.n_used == 2
+    # eviction frees LRU refcount-1 entries, never shared ones
+    pool.incref(pages[0])  # simulate another slot mapping the page
+    freed = cache.evict(10)
+    assert freed == 1  # only pages[1] was evictable
+    assert cache.match(prompt) == pages[:1]  # shared entry survived
+    pool.decref(pages[0])
+
+
+def test_allocator_admit_retire_rematch():
+    alloc = PagedKVAllocator(n_pages=32, page_size=2,
+                             max_pages_per_slot=16, prefill_chunk=2)
+    prompt = [3, 4, 5, 6, 7, 8]
+    plan = alloc.try_admit(prompt, budget=4)
+    assert plan is not None and plan.matched_len == 0
+    assert plan.n_pages == pages_for(len(prompt) + 4 + 1, 2)
+    alloc.bind(0, plan)
+    alloc.retire(0, prompt)
+    # the identical prompt now matches its cached prefix chunks; the
+    # last prompt token is always recomputed, so matched_len is capped
+    # at plen - 1 = 5 -> 2 shared full chunks + a mid-chunk divergence
+    plan2 = alloc.try_admit(prompt, budget=4)
+    assert plan2 is not None
+    assert plan2.matched_len == 5 and plan2.n_shared == 2
+    assert plan2.cow_dst > 0  # divergence mid-chunk -> COW
+    alloc.bind(1, plan2)
+    alloc.cow_flush()
+    alloc.retire(1, prompt)
+    alloc.cow_flush()
+    assert alloc.prefix_hit_rate() > 0
+    alloc.check_invariants()
+
+
+def test_allocator_backpressure_and_impossible():
+    alloc = PagedKVAllocator(n_pages=6, page_size=2, max_pages_per_slot=8,
+                             prefill_chunk=1)
+    assert not alloc.admissible(plen=12, budget=8)  # > pool capacity
+    assert alloc.admissible(plen=4, budget=4)
+    p1 = alloc.try_admit([1, 2, 3, 4], budget=4)
+    assert p1 is not None
+    alloc.bind(0, p1)
+    # pool drained -> deferred, and the refused admission leaks nothing
+    before = alloc.pool.n_free
+    assert alloc.try_admit([9, 8, 7, 6], budget=4) is None
+    assert alloc.pool.n_free == before
+    alloc.release(0)
+    alloc.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# Page-table discipline checks (analysis.table_check)
+# ---------------------------------------------------------------------------
+
+
+def test_page_table_hazard_kinds():
+    ref = [1, 1, 1, 2, 0, 0, 0, 0]  # pages 1-2 live (2 shared), 4+ free
+    ok = page_table_hazards([1, 2], refcount=ref, n_pages=8, page_size=4,
+                            write_lo=4, write_hi=8)
+    assert ok == []
+    kinds = {h.kind for h in page_table_hazards(
+        [9], refcount=ref, n_pages=8, page_size=4, write_lo=0, write_hi=4)}
+    assert "page-oob" in kinds
+    kinds = {h.kind for h in page_table_hazards(
+        [5], refcount=ref, n_pages=8, page_size=4, write_lo=0, write_hi=4)}
+    assert "page-dead" in kinds
+    kinds = {h.kind for h in page_table_hazards(
+        [2, 2], refcount=ref, n_pages=8, page_size=4,
+        write_lo=4, write_hi=8)}
+    assert "page-dup" in kinds
+    kinds = {h.kind for h in page_table_hazards(
+        [2], refcount=ref, n_pages=8, page_size=4, write_lo=0, write_hi=8)}
+    assert "page-underalloc" in kinds
+    # writing into a shared page is the COW hazard — unless that page
+    # IS the declared COW destination
+    shared = page_table_hazards([3, 2], refcount=ref, n_pages=8,
+                                page_size=4, write_lo=0, write_hi=8)
+    assert "page-shared-write" in {h.kind for h in shared}
+    assert page_table_hazards([3, 2], refcount=ref, n_pages=8,
+                              page_size=4, write_lo=0, write_hi=8,
+                              cow_dst=3) == []
+
+
+def test_check_serving_ring_merges_paging_hazards():
+    paging = {
+        "page_size": 4, "n_pages": 8,
+        "page_tbl": [[1, 2, 4], [1, 3, 0]],
+        "refcount": [1, 2, 1, 1, 1, 0, 0, 0],
+        "spans": [(4, 12), (0, 0)],  # slot 1 idle -> skipped
+    }
+    assert check_serving_ring(2, 2, paging=paging).ok
+    paging_bad = dict(paging, spans=[(0, 12), (0, 0)])  # writes shared pg 1
+    report = check_serving_ring(2, 2, paging=paging_bad)
+    assert not report.ok
+    assert any(h.kind == "page-shared-write" for h in report.hazards)
+
+
+# ---------------------------------------------------------------------------
+# Engine integration (compiles — shared fixtures, small shapes)
+# ---------------------------------------------------------------------------
+
+
+def test_paged_bit_parity_and_sharing(gpt2):
+    """One contiguous + one paged program, three replays:
+
+    1. random prompts (no shared prefixes): exact token parity — the
+       paged gather reconstructs the contiguous view bit-for-bit;
+    2. shared-prefix batch: parity again, now THROUGH the radix cache
+       (hit rate > 0, prefill actually skipped, COW on divergence);
+    3. accounting: zero failures, clean drain, exactly one compile.
+    """
+    cfg, params = gpt2
+    mesh = make_mesh(n_pipe=2)
+    kw = dict(n_slots=3, max_len=32, prompt_max=12, out_max=16,
+              prefill_chunk=2, eos_id=EOS)
+    prog_c = make_serving_step_fn(cfg, mesh, **kw)
+    prog_p = make_serving_step_fn(cfg, mesh, paged=True, page_size=4, **kw)
+    eng_c = ServingEngine(prog_c, params)
+    eng_p = ServingEngine(prog_p, params)
+
+    rng = np.random.RandomState(0)
+    reqs = [Request(rid=i,
+                    prompt=[int(t) for t in
+                            rng.randint(1, cfg.vocab_size,
+                                        size=rng.randint(1, 9))],
+                    max_new_tokens=int(rng.randint(1, 11)),
+                    arrival=float(i))
+            for i in range(6)]
+    res_c = eng_c.run(list(reqs))
+    toks_c = {c.rid: c.tokens for c in res_c.completions}
+    res_p = eng_p.run(list(reqs))
+    assert {c.rid: c.tokens for c in res_p.completions} == toks_c
+    assert res_p.n_failed == 0 and res_p.paged
+    eng_p.paging.check_invariants()
+
+    # identical 8-token prompts, serialized arrivals so each request
+    # retires (feeding the trie) before the next admits: the cap at
+    # plen - 1 = 7 lands mid-page -> 1 shared page + a COW copy each
+    shared = [int(t) for t in rng.randint(1, cfg.vocab_size, size=8)]
+    reqs2 = [Request(rid=100 + i, prompt=list(shared),
+                     max_new_tokens=6, arrival=float(i) * 40)
+             for i in range(3)]
+    toks_c2 = {c.rid: c.tokens
+               for c in eng_c.run(list(reqs2)).completions}
+    res_p2 = eng_p.run(list(reqs2))
+    assert {c.rid: c.tokens for c in res_p2.completions} == toks_c2
+    assert res_p2.prefix_hit_rate > 0
+    assert res_p2.prefill_skipped_tokens > 0
+    assert res_p2.n_cow > 0
+    eng_p.paging.check_invariants()
+    assert prog_p.step._cache_size() == 1
+
+    # measurement surface: the summary carries the page gauges and the
+    # curve-row columns regress.py guards
+    from distributed_training_with_pipeline_parallelism_tpu.utils.telemetry import (
+        serving_summary)
+    s = serving_summary(res_p2)
+    assert s["paged"] and s["pages_capacity"] == prog_p.n_pages - 1
+    assert s["prefix_hit_rate"] > 0 and s["pages_used_max"] > 0
+    assert "paged" not in serving_summary(res_c)
+
+
+def test_paged_exhaustion_backpressure_never_fails(gpt2):
+    """A pool that fits ~one request at a time: admissions defer
+    (backpressure) until slots retire and free pages — every request
+    still completes; only a request that could never fit fails."""
+    cfg, params = gpt2
+    mesh = make_mesh(n_pipe=2)
+    kw = dict(n_slots=3, max_len=32, prompt_max=8, out_max=10,
+              prefill_chunk=2, eos_id=None)
+    # each request needs pages_for(8 + 10 + 1, 4) = 5 pages; 7 usable
+    prog = make_serving_step_fn(cfg, mesh, paged=True, page_size=4,
+                                n_pages=8, **kw)
+    rng = np.random.RandomState(1)
+    reqs = [Request(rid=i,
+                    prompt=[int(t) for t in
+                            rng.randint(1, cfg.vocab_size, size=8)],
+                    max_new_tokens=10, arrival=0.0)
+            for i in range(5)]
+    eng = ServingEngine(prog, params)
+    res = eng.run(list(reqs))
+    assert res.n_failed == 0
+    assert len(res.completions) == len(reqs)
+    assert res.n_backpressure > 0
+    eng.paging.check_invariants()
+    assert prog.step._cache_size() == 1
+
+    # a request that could NEVER fit the pool fails immediately with a
+    # reason instead of deadlocking the admission queue
+    tiny = make_serving_step_fn(cfg, mesh, paged=True, page_size=4,
+                                n_pages=4, **kw)
+    res2 = ServingEngine(tiny, params).run(
+        [Request(rid=0, prompt=[1] * 8, max_new_tokens=10)])
+    assert res2.n_failed == 1
+    assert res2.completions[0].status == "failed"
+    assert "pages" in (res2.completions[0].reason or "")
+
+
+def test_paged_parity_llama_tp_pp():
+    """TP x PP: the pool's n_kv dimension is MODEL_AXIS-sharded; the
+    paged gather must stay shard-local and bit-match contiguous."""
+    cfg = dtpp.ModelConfig(dim=64, n_layers=4, n_heads=4, n_kv_heads=2,
+                           vocab_size=128, ffn_dim=128, max_seq_len=64,
+                           arch="llama")
+    params = tfm.transformer_init(jax.random.key(1), cfg)
+    mesh = make_mesh(n_pipe=2, n_model=2)
+    kw = dict(n_slots=2, max_len=16, prompt_max=6, out_max=6,
+              prefill_chunk=2, eos_id=5)
+    rng = np.random.RandomState(2)
+    reqs = [Request(rid=i,
+                    prompt=[int(t) for t in
+                            rng.randint(1, cfg.vocab_size,
+                                        size=rng.randint(1, 7))],
+                    max_new_tokens=int(rng.randint(1, 7)),
+                    arrival=float(i))
+            for i in range(4)]
+    rc = ServingEngine(make_serving_step_fn(cfg, mesh, **kw), params)
+    rp = ServingEngine(make_serving_step_fn(cfg, mesh, paged=True,
+                                            page_size=4, **kw), params)
+    toks_c = {c.rid: c.tokens for c in rc.run(list(reqs)).completions}
+    toks_p = {c.rid: c.tokens for c in rp.run(list(reqs)).completions}
+    assert toks_c == toks_p
+
+
+# ---------------------------------------------------------------------------
+# Pricing: matched budgets and preflight
+# ---------------------------------------------------------------------------
+
+
+def test_matched_budget_plan_and_preflight(gpt2):
+    """The budget split behind the paged-vs-contiguous comparison: the
+    default budget buys exactly n_slots contiguous slots, the page pool
+    prices to the same bytes, and the paged side provisions at least as
+    many slots; an over-budget pool config fails oom_preflight (the
+    sweep's skip_reason="predicted_oom" path) without compiling."""
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.cost_model import (
+        HardwareSpec)
+    from distributed_training_with_pipeline_parallelism_tpu.analysis.memory_model import (
+        kv_page_bytes, oom_preflight, serving_memory_section)
+    from distributed_training_with_pipeline_parallelism_tpu.serving.bench import (
+        matched_budget_plan)
+    from distributed_training_with_pipeline_parallelism_tpu.serving.loadgen import (
+        make_workload)
+
+    cfg, params = gpt2
+    trace = make_workload(16, "prefix", prefill_chunk=2, load=1.0,
+                          vocab_size=cfg.vocab_size, seed=0)
+    plan = matched_budget_plan(cfg, trace, n_devices=2, n_slots=4,
+                               max_len=32, prefill_chunk=2, page_size=4)
+    assert plan["contiguous_slots"] == 4
+    assert plan["paged_slots"] >= plan["contiguous_slots"]
+    pool_b = plan["n_pages"] * plan["page_bytes"]
+    assert pool_b <= plan["budget_bytes"] < pool_b + plan["page_bytes"]
+
+    # preflight: price a paged program against a synthetic chip whose
+    # HBM is smaller than the pool -> skip row, no compile needed
+    mesh = make_mesh(n_pipe=2)
+    prog = make_serving_step_fn(cfg, mesh, n_slots=3, max_len=32,
+                                prompt_max=8, out_max=10, prefill_chunk=2,
+                                eos_id=None, paged=True, page_size=4)
+    section = serving_memory_section(cfg, prog)
+    paged_info = section["analytic"]["paged"]
+    assert paged_info["n_pages"] == prog.n_pages
+    assert paged_info["pool_bytes_per_device"] == pytest.approx(
+        prog.n_pages * kv_page_bytes(cfg, n_devices=2, page_size=4))
+    tiny_hbm = HardwareSpec(name="toy", peak_flops=1e12,
+                            ici_bytes_per_s=1e10, hbm_bytes_per_s=1e11,
+                            hbm_bytes=float(paged_info[
+                                "pool_bytes_per_device"] // 2))
+    pf = oom_preflight(section, hardware=tiny_hbm)
+    assert not pf["ok"]
+    roomy = HardwareSpec(name="toy", peak_flops=1e12,
+                         ici_bytes_per_s=1e10, hbm_bytes_per_s=1e11,
+                         hbm_bytes=1e12)
+    assert oom_preflight(section, hardware=roomy)["ok"]
+
+
+def test_prefix_workload_mix_deterministic():
+    """The prefix mix prepends one of n_prefixes seeded prefixes to the
+    base stream; same seed -> byte-identical trace, and arrivals/budgets
+    ride the base stream unchanged (ramp stability)."""
+    from distributed_training_with_pipeline_parallelism_tpu.serving.loadgen import (
+        WORKLOAD_MIXES, make_workload)
+    a = make_workload(12, "prefix", prefill_chunk=2, load=0.8, seed=3)
+    b = make_workload(12, "prefix", prefill_chunk=2, load=0.8, seed=3)
+    assert [(r.rid, r.prompt, r.max_new_tokens, r.arrival) for r in a] \
+        == [(r.rid, r.prompt, r.max_new_tokens, r.arrival) for r in b]
+    base = make_workload(12, WORKLOAD_MIXES["prefix"]["base"],
+                         prefill_chunk=2, load=0.8, seed=3)
+    pre_len = WORKLOAD_MIXES["prefix"]["prefix_len"]
+    prefixes = {tuple(r.prompt[:pre_len]) for r in a}
+    assert len(prefixes) <= WORKLOAD_MIXES["prefix"]["n_prefixes"]
+    for r, rb in zip(a, base):
+        assert r.prompt[pre_len:] == list(rb.prompt)
+        assert (r.max_new_tokens, r.arrival) == (rb.max_new_tokens,
+                                                 rb.arrival)
