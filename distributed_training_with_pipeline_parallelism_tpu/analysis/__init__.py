@@ -48,6 +48,7 @@ production runs — the checks are pure numpy but nonzero).
 """
 
 import os
+from typing import Optional
 
 VERIFIER_VERSION = 1
 
@@ -92,13 +93,20 @@ def maybe_verify_forward_table(table, n_devices: int, n_virtual: int,
             + "; ".join(str(h) for h in report.hazards[:8]))
 
 
-def maybe_verify_serving(n_devices: int, n_slots: int) -> None:
+def maybe_verify_serving(n_devices: int, n_slots: int,
+                         gamma: Optional[int] = None,
+                         prefill_chunk: Optional[int] = None) -> None:
     """Build-time hook for the serving executor's round-robin ring
-    (``serving.engine.make_serving_step_fn``)."""
+    (``serving.engine.make_serving_step_fn``). Speculative programs pass
+    ``gamma``/``prefill_chunk`` so the widened-metadata checks (verify
+    chunk fits the channel, acceptance bounds well-formed) run at build
+    time too."""
     if not verify_tables_enabled():
         return
     from .table_check import check_serving_ring
-    report = check_serving_ring(n_devices, n_slots)
+    spec = (dict(gamma=gamma, prefill_chunk=prefill_chunk)
+            if gamma is not None else None)
+    report = check_serving_ring(n_devices, n_slots, speculative=spec)
     if not report.ok:
         raise ValueError(
             f"serving ring verification failed (D={n_devices}, "
@@ -138,6 +146,7 @@ _LAZY = {
     "check_serving_ring": ("table_check", "check_serving_ring"),
     "check_page_table": ("table_check", "check_page_table"),
     "page_table_hazards": ("table_check", "page_table_hazards"),
+    "speculative_hazards": ("table_check", "speculative_hazards"),
     "static_analysis_section": ("table_check", "static_analysis_section"),
     "JaxprAudit": ("jaxpr_audit", "JaxprAudit"),
     "audit_jaxpr": ("jaxpr_audit", "audit_jaxpr"),
@@ -154,6 +163,8 @@ _LAZY = {
     "cost_model_section": ("cost_model", "cost_model_section"),
     "serving_cost_model_section": ("cost_model",
                                    "serving_cost_model_section"),
+    "expected_tokens_per_verify": ("cost_model",
+                                   "expected_tokens_per_verify"),
     "train_flops_per_token": ("cost_model", "train_flops_per_token"),
     "fwd_flops_per_token": ("cost_model", "fwd_flops_per_token"),
     "resolve_backward_policy": ("cost_model", "resolve_backward_policy"),

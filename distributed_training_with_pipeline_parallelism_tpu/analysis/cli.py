@@ -98,6 +98,25 @@ def run_table_checks(grid: Optional[List[GridEntry]] = None
     }
     reports.append(check_serving_ring(2, 4, paging=paging).summary())
     n_hazards += reports[-1]["n_hazards"]
+    # ISSUE 20: speculative widened-metadata discipline over a synthetic
+    # draft-verify ring — gamma=2 inside a prefill_chunk=3 channel, two
+    # slots mid-verify with in-range accepted lengths, committed
+    # frontiers at/behind the accepted position, and page rows covering
+    # the verify chunk's junk tail. Hazard-free by construction; the
+    # negative cases (accept OOB, commit overrun, draft overrun) live in
+    # the unit tests.
+    speculative = {
+        "gamma": 2, "prefill_chunk": 3,
+        "slots": [
+            {"slot": 0, "n_accepted": 3, "pos": 9, "committed": 8,
+             "mapped_rows": 16},
+            {"slot": 1, "n_accepted": 1, "pos": 5, "committed": 5,
+             "mapped_rows": 12},
+        ],
+    }
+    reports.append(check_serving_ring(2, 4,
+                                      speculative=speculative).summary())
+    n_hazards += reports[-1]["n_hazards"]
     return {"n_checked": len(reports), "n_hazards": n_hazards,
             "ok": n_hazards == 0, "reports": reports}
 

@@ -550,9 +550,29 @@ def cost_model_section(cs: CompiledSchedule, cfg, *, batch_size: int,
     return section
 
 
+def expected_tokens_per_verify(alpha: float, gamma: int) -> float:
+    """Expected emitted tokens per verify forward under greedy
+    speculative decoding with per-position acceptance rate ``alpha``
+    and draft length ``gamma`` (Leviathan et al., arXiv:2211.17192):
+
+        E[tokens] = (1 - alpha^(gamma+1)) / (1 - alpha)
+
+    i.e. the run-length of i.i.d. accepts plus the free token the
+    verify forward always yields. Continuous at the endpoints:
+    ``gamma + 1`` as ``alpha -> 1`` and ``1`` at ``alpha = 0``."""
+    g = int(gamma)
+    if g < 0:
+        raise ValueError(f"gamma must be >= 0, got {gamma}")
+    a = min(max(float(alpha), 0.0), 1.0)
+    if a >= 1.0:
+        return float(g + 1)
+    return (1.0 - a ** (g + 1)) / (1.0 - a)
+
+
 def serving_cost_model_section(cfg, n_pipe: int, n_slots: int,
                                summary: Dict[str, Any],
                                hardware: Optional[HardwareSpec] = None,
+                               draft_cfg=None, correction=None,
                                ) -> Dict[str, Any]:
     """Cost-model section for a serving run (same manifest schema).
 
@@ -560,7 +580,17 @@ def serving_cost_model_section(cfg, n_pipe: int, n_slots: int,
     ring once; predicted per-tick time is the roofline on one token's
     stage slice plus one hop of a ``dim``-wide activation row. Measured
     MFU uses forward FLOPs only (decoding trains nothing). ``summary``:
-    a ``serving_summary`` dict (ticks, wall_s, tokens_out...)."""
+    a ``serving_summary`` dict (ticks, wall_s, tokens_out...).
+
+    When ``summary`` carries the speculative gauges
+    (``speculative``/``gamma``/``acceptance_rate``) a ``speculative``
+    subsection prices the draft-verify tick: target verify FLOPs over
+    ``gamma+1`` rows, draft FLOPs (``draft_cfg``, replicated so not
+    divided by the pipe degree) for ``gamma`` proposals, expected
+    tokens/tick from the measured acceptance rate, and the predicted
+    saturation-knee shift — de-rated through ``correction``
+    (calibration-fitted efficiency scalars, same contract as
+    :func:`cost_model_section`) when available."""
     hw = hardware if hardware is not None else detect_hardware()
     seq = cfg.max_seq_len
     fwd_tok = fwd_flops_per_token(cfg, seq)
@@ -617,4 +647,54 @@ def serving_cost_model_section(cfg, n_pipe: int, n_slots: int,
             "predicted_over_measured":
                 section["predicted"]["step_s"] / (wall_s / ticks),
         }
+
+    if summary.get("speculative"):
+        gamma = int(summary.get("gamma") or 0)
+        alpha = summary.get("acceptance_rate")
+        exp_tok = expected_tokens_per_verify(
+            alpha if alpha is not None else 0.0, gamma)
+        draft_tok = (fwd_flops_per_token(draft_cfg, seq)
+                     if draft_cfg is not None else 0.0)
+        # verify widens the target forward to gamma+1 rows; the draft is
+        # replicated (stage 0 runs it for every slot), so its FLOPs are
+        # NOT divided by the pipe degree
+        verify_s = (gamma + 1) * fwd_tok / n_pipe / hw.peak_flops
+        draft_s = gamma * draft_tok / hw.peak_flops
+        base_tick_s = per_tick_compute_s + hop_s
+        spec_tick_s = verify_s + draft_s + hop_s
+        # tokens/s scale = (tokens per tick gain) / (tick cost gain);
+        # offered-load capacity is tokens/s-limited at saturation, so
+        # the knee is predicted to shift by the same factor
+        knee_scale = (exp_tok / (spec_tick_s / base_tick_s)
+                      if base_tick_s > 0 else None)
+        spec: Dict[str, Any] = {
+            "gamma": gamma,
+            "acceptance_rate": alpha,
+            "expected_tokens_per_tick": exp_tok,
+            "draft_flops_per_token": draft_tok,
+            "flops_per_tick": {
+                "verify": (gamma + 1) * fwd_tok,
+                "draft": gamma * draft_tok,
+            },
+            "predicted": {
+                "tick_s": spec_tick_s,
+                "s_per_token": spec_tick_s / exp_tok,
+                "baseline_s_per_token": base_tick_s,
+                "tokens_per_sec_scale": knee_scale,
+                "knee_scale": knee_scale,
+            },
+        }
+        corr = _resolve_correction(correction, hw.name)
+        if corr is not None:
+            e_f = float(corr.flops_efficiency)
+            e_b = float(corr.bandwidth_efficiency)
+            c_base = per_tick_compute_s / e_f + hop_s / e_b
+            c_tick = (verify_s + draft_s) / e_f + hop_s / e_b
+            spec["predicted"]["corrected"] = {
+                "tick_s": c_tick,
+                "s_per_token": c_tick / exp_tok,
+                "knee_scale": (exp_tok / (c_tick / c_base)
+                               if c_base > 0 else None),
+            }
+        section["speculative"] = spec
     return section

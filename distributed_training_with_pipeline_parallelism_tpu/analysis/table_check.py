@@ -1128,8 +1128,71 @@ def check_page_table(page_tbl, *, refcount, n_pages: int, page_size: int,
         compression={})
 
 
+def speculative_hazards(*, gamma: int, prefill_chunk: int,
+                        slots=()) -> List[Hazard]:
+    """Discipline hazards for the widened speculative metadata columns
+    (ISSUE 20 satellite). Static rules first:
+
+    - ``1 <= gamma`` and ``gamma + 1 <= prefill_chunk`` — the verify
+      forward reuses the chunked-prefill channel width ``C``, and the
+      rollback-by-overwrite discipline needs the next ``C``-wide write to
+      cover every speculative overshoot row (``spec-gamma-oob``);
+
+    then per-slot rules over ``slots``, an optional iterable of dicts
+    with ``pos`` (committed token frontier), ``n_accepted`` (the value
+    banked from the tok channel), ``committed`` (the paged allocator's
+    committed-frontier ledger entry) and ``mapped_rows`` (rows covered by
+    the slot's page row; ``None`` for contiguous slots):
+
+    - ``n_accepted`` outside ``[1, gamma + 1]`` would advance the slot
+      past the verify chunk or stall it forever (``spec-accept-oob``);
+    - a committed frontier ahead of ``pos`` means draft overshoot leaked
+      into the radix trie / COW pool (``spec-commit-overrun``);
+    - a verify chunk whose junk tail extends past the mapped page span
+      would scatter draft writes into unmapped rows
+      (``spec-draft-overrun``).
+    """
+    hazards: List[Hazard] = []
+    if gamma < 1:
+        hazards.append(Hazard(
+            "spec-gamma-oob", -1, -1, "gamma",
+            f"gamma={gamma} < 1: speculative program proposes no tokens"))
+    if gamma + 1 > prefill_chunk:
+        hazards.append(Hazard(
+            "spec-gamma-oob", -1, -1, "gamma",
+            f"gamma+1={gamma + 1} > prefill_chunk={prefill_chunk}: the "
+            f"verify chunk does not fit the channel width, so rejected "
+            f"rows would never be overwritten by the next write"))
+    for s, row in enumerate(slots or ()):
+        slot = int(row.get("slot", s))
+        n_acc = row.get("n_accepted")
+        if n_acc is not None and not (1 <= int(n_acc) <= gamma + 1):
+            hazards.append(Hazard(
+                "spec-accept-oob", slot, -1, "tok_chan",
+                f"slot {slot} banked n_accepted={int(n_acc)} outside "
+                f"[1, {gamma + 1}]"))
+        pos = row.get("pos")
+        committed = row.get("committed")
+        if pos is not None and committed is not None \
+                and int(committed) > int(pos):
+            hazards.append(Hazard(
+                "spec-commit-overrun", slot, -1, "page_tbl",
+                f"slot {slot} committed frontier {int(committed)} > "
+                f"accepted position {int(pos)}: speculative overshoot "
+                f"leaked into committed pages"))
+        mapped = row.get("mapped_rows")
+        if pos is not None and mapped is not None \
+                and int(pos) + prefill_chunk > int(mapped):
+            hazards.append(Hazard(
+                "spec-draft-overrun", slot, -1, "page_tbl",
+                f"slot {slot} verify chunk [{int(pos)}, "
+                f"{int(pos) + prefill_chunk}) extends past mapped rows "
+                f"{int(mapped)}"))
+    return hazards
+
+
 def check_serving_ring(n_devices: int, n_slots: int,
-                       paging=None) -> TableReport:
+                       paging=None, speculative=None) -> TableReport:
     """Verify the serving executor's implicit round-robin slot schedule.
 
     ``serving.engine`` has no tick table: at tick ``u`` device ``d`` serves
@@ -1148,9 +1211,19 @@ def check_serving_ring(n_devices: int, n_slots: int,
     check: a dict with ``page_tbl``, ``refcount``, ``n_pages``,
     ``page_size``, ``spans`` and optional ``cow_dst`` as accepted by
     :func:`check_page_table`; its hazards are merged into this report.
+
+    ``speculative`` (optional) runs the widened-metadata discipline
+    check for draft-verify programs: a dict with ``gamma``,
+    ``prefill_chunk`` and optional ``slots`` as accepted by
+    :func:`speculative_hazards`; its hazards are merged too.
     """
     D, M = n_devices, n_slots
     hazards: List[Hazard] = []
+    if speculative is not None:
+        hazards.extend(speculative_hazards(
+            gamma=speculative["gamma"],
+            prefill_chunk=speculative["prefill_chunk"],
+            slots=speculative.get("slots", ())))
     if paging is not None:
         hazards.extend(check_page_table(
             paging["page_tbl"], refcount=paging["refcount"],

@@ -155,6 +155,30 @@ def _head_token(cfg: ModelConfig, head_c, embed_c, y_last, key, *,
     return jnp.take_along_axis(idxs, win[None], axis=0)[0].astype(jnp.int32)
 
 
+def spec_accept_len(drafts, targets):
+    """Longest-matching-prefix acceptance for greedy speculative decoding
+    (serving.engine's verify step; Leviathan et al., arXiv:2211.17192).
+
+    ``drafts`` [gamma]: the draft model's proposed tokens. ``targets``
+    [>= gamma]: the target model's per-row argmaxes over the verify
+    chunk, where row ``i`` conditions on the context *through draft
+    ``i``* — so ``targets[i]`` is what greedy decoding would emit after
+    accepting ``drafts[:i+1]``... but also, crucially, ``targets[i-1]``
+    is what it emits after ``drafts[:i]``, which is why draft ``i`` is
+    acceptable iff ``drafts[i] == targets[i-1]`` with ``targets[-1]``
+    read as the free token row 0 yields. Returns ``n_accepted = 1 +
+    run-length of the matching prefix`` in ``[1, gamma+1]`` — bit-exact
+    greedy by construction: the first mismatch row's own argmax is the
+    token greedy would have emitted, and it rides the tok channel as
+    ``targets[n_accepted - 1]``. Traceable (jnp) and numpy-compatible,
+    so the unit tests run it directly on host arrays."""
+    drafts = jnp.asarray(drafts)
+    g = drafts.shape[0]
+    hit = jnp.cumprod(
+        (drafts == jnp.asarray(targets)[:g]).astype(jnp.int32))
+    return 1 + hit.sum()
+
+
 def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh,
                               max_new_tokens: int, *,
                               n_streams: Optional[int] = None,

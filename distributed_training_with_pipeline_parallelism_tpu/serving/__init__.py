@@ -33,6 +33,7 @@ _LAZY = {
     "matched_budget_plan": ("bench", "matched_budget_plan"),
     "run_paged_bench": ("bench", "run_paged_bench"),
     "run_serve_bench": ("bench", "run_serve_bench"),
+    "run_spec_bench": ("bench", "run_spec_bench"),
     "synth_trace": ("bench", "synth_trace"),
     "WORKLOAD_MIXES": ("loadgen", "WORKLOAD_MIXES"),
     "make_workload": ("loadgen", "make_workload"),

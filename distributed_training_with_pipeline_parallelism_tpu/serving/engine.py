@@ -42,6 +42,25 @@ exact even though the host only observes block boundaries. Sampling is
 greedy (temperature 0): continuous batching interleaves requests into
 one sequential token stream, and greedy is what the oracle-parity tests
 pin against single-device :func:`...models.generate.generate`.
+
+*Speculative decoding* (``speculative=True``, Leviathan et al.,
+arXiv:2211.17192) multiplies decode tokens per visit without changing a
+single shape: a small replicated draft model runs on stage 0 inside the
+same compiled block and proposes ``gamma`` tokens per verify visit; the
+target pipeline scores all ``gamma + 1`` positions in ONE forward by
+reusing the C-wide chunked-prefill channel (``gamma + 1 <= C``), and the
+longest matching prefix of proposals is accepted — ``n_accepted ∈
+[1, gamma+1]`` tokens bank per visit. Everything data-dependent rides
+the widened metadata ring (``isverify`` flag + the gamma draft tokens)
+or the widened ``[gamma+2]`` token channel (per-row argmaxes +
+``n_accepted``), so the block still compiles exactly once. Rejected
+rows are *rolled back by overwrite*: they land past the accepted
+frontier, the band mask keeps them invisible (masked scores contribute
+exact zeros), and the slot's next C-wide write covers them before the
+frontier arrives — the same junk-row discipline chunked prefill already
+relies on. Greedy outputs are bit-identical to the non-speculative
+engine by construction (an accepted token's context is exactly the
+greedy context; tests/test_serving_spec.py pins it).
 """
 
 from __future__ import annotations
@@ -61,7 +80,8 @@ from ..models.transformer import compute_cast
 from ..parallel.mesh import MODEL_AXIS, PIPE_AXIS
 from ..parallel.pipeline import (_check_tp_divisibility, _dense_layer_specs,
                                  _shard_map, stack_stage_layers)
-from ..parallel.pipelined_decode import _head_token, _slot_cache_apply
+from ..parallel.pipelined_decode import (_head_token, _slot_cache_apply,
+                                         spec_accept_len)
 from ..utils.config import ModelConfig
 
 # state leaves the host scheduler reads back after every block (small:
@@ -78,6 +98,11 @@ _SCHED_KEYS = _HOST_KEYS + ("budget", "plen", "live", "prompt_buf")
 _PAGED_HOST_KEYS = _HOST_KEYS + ("cow_src", "cow_dst")
 _PAGED_SCHED_KEYS = _PAGED_HOST_KEYS + ("budget", "plen", "live",
                                         "prompt_buf", "page_tbl")
+# speculative mode adds the draft-model frontier plus the acceptance
+# counters (verify visits / accepted proposals per slot) to both sets:
+# the host resets them at admission and reads them back for the
+# acceptance-rate gauges
+_SPEC_KEYS = ("dpos", "spec_visits", "spec_accepted")
 
 
 def _paged_cache_apply(cfg: ModelConfig, layers_d, h, kp, vp, pt_row,
@@ -202,6 +227,32 @@ class ServeResult:
     prefill_skipped_tokens: int = 0
     n_cow: int = 0
     n_backpressure: int = 0
+    # speculative-mode gauges (zero/None on plain runs): verify visits
+    # and accepted proposals summed over all completions, plus the
+    # (tick, running acceptance rate) series sampled at block boundaries
+    speculative: bool = False
+    gamma: int = 0
+    spec_verify_visits: int = 0
+    spec_accepted_tokens: int = 0
+    acceptance_series: List[Any] = dataclasses.field(default_factory=list)
+
+    @property
+    def acceptance_rate(self) -> Optional[float]:
+        """Accepted proposals over offered proposals: ``sum(n_acc - 1) /
+        (gamma * verify_visits)`` — the measured alpha the cost model's
+        expected-tokens formula takes. None until a verify visit ran."""
+        if not (self.speculative and self.gamma and self.spec_verify_visits):
+            return None
+        return self.spec_accepted_tokens / (self.gamma
+                                            * self.spec_verify_visits)
+
+    @property
+    def accepted_len_mean(self) -> Optional[float]:
+        """Mean tokens banked per verify visit (``1 + gamma * alpha`` in
+        expectation, in ``[1, gamma+1]`` always)."""
+        if not (self.speculative and self.spec_verify_visits):
+            return None
+        return 1.0 + self.spec_accepted_tokens / self.spec_verify_visits
 
     @property
     def tokens_out(self) -> int:
@@ -258,7 +309,9 @@ class ServingProgram:
                  prefill_chunk: int, block_ticks: int,
                  eos_id: Optional[int], step_fn, state_specs,
                  paged: bool = False, page_size: int = 0,
-                 n_pages: int = 0) -> None:
+                 n_pages: int = 0, speculative: bool = False,
+                 gamma: int = 0,
+                 draft_cfg: Optional[ModelConfig] = None) -> None:
         self.cfg = cfg
         self.mesh = mesh
         self.n_slots = n_slots
@@ -275,6 +328,9 @@ class ServingProgram:
         self.paged = paged
         self.page_size = page_size
         self.n_pages = n_pages
+        self.speculative = speculative
+        self.gamma = gamma
+        self.draft_cfg = draft_cfg
 
     @property
     def max_pages_per_slot(self) -> int:
@@ -285,11 +341,13 @@ class ServingProgram:
 
     @property
     def host_keys(self) -> tuple:
-        return _PAGED_HOST_KEYS if self.paged else _HOST_KEYS
+        base = _PAGED_HOST_KEYS if self.paged else _HOST_KEYS
+        return base + _SPEC_KEYS if self.speculative else base
 
     @property
     def sched_keys(self) -> tuple:
-        return _PAGED_SCHED_KEYS if self.paged else _SCHED_KEYS
+        base = _PAGED_SCHED_KEYS if self.paged else _SCHED_KEYS
+        return base + _SPEC_KEYS if self.speculative else base
 
     def sharding(self, key: str):
         from jax.sharding import NamedSharding
@@ -302,11 +360,20 @@ class ServingProgram:
     def mlen_alloc(self) -> int:
         return self.max_len + self.prefill_chunk - 1
 
-    def prepare(self, params) -> tuple:
+    def prepare(self, params, draft_params=None) -> tuple:
         """Pre-stack the layer pytree for the pipe mesh (once per
-        weights, not per block)."""
-        return (stack_stage_layers(params["layers"], self.n_stages, 1),
-                params["embed"], params["head"])
+        weights, not per block). Speculative programs additionally take
+        the replicated draft model's params (same ``transformer_init``
+        pytree for ``draft_cfg``)."""
+        out = (stack_stage_layers(params["layers"], self.n_stages, 1),
+               params["embed"], params["head"])
+        if not self.speculative:
+            return out
+        if draft_params is None:
+            raise ValueError("speculative programs need draft_params "
+                             "(the draft model's weight pytree)")
+        return out + (stack_stage_layers(draft_params["layers"], 1, 1),
+                      draft_params["embed"], draft_params["head"])
 
     def init_state(self) -> Dict[str, jax.Array]:
         cfg, M, C, D = self.cfg, self.n_slots, self.prefill_chunk, \
@@ -332,14 +399,37 @@ class ServingProgram:
             cache_shape = (D, lps, M, self.mlen_alloc, n_kv, cfg.head_dim)
             meta_w = 4
             paged_state = {}
+        spec_state = {}
+        tok_w = 1
+        if self.speculative:
+            # draft KV rides every stage's shard slot (uniform [None]
+            # wrap), but only stage 0's shard ever holds data — the
+            # draft runs replicated on stage 0. meta gains the isverify
+            # flag + the gamma draft tokens; tok_chan widens to the
+            # per-row argmaxes + n_accepted.
+            dcfg = self.draft_cfg
+            meta_w += 1 + self.gamma
+            tok_w = self.gamma + 2
+            n_kv_d = dcfg.n_kv_heads or dcfg.n_heads
+            dshape = (D, dcfg.n_layers, M, self.mlen_alloc, n_kv_d,
+                      dcfg.head_dim)
+            ddt = jnp.dtype(dcfg.dtype)
+            spec_state = {
+                "dkc": jnp.zeros(dshape, ddt),
+                "dvc": jnp.zeros(dshape, ddt),
+                "dpos": jnp.zeros((M,), i32),
+                "spec_visits": jnp.zeros((M,), i32),
+                "spec_accepted": jnp.zeros((M,), i32),
+            }
         state = {
             "u": jnp.zeros((), i32),
             "h": jnp.zeros((D, 1, C, cfg.dim), dt),
-            "tok_chan": jnp.zeros((D, 1), i32),
+            "tok_chan": jnp.zeros((D, tok_w), i32),
             "meta": jnp.zeros((D, meta_w), i32),
             "kc": jnp.zeros(cache_shape, dt),
             "vc": jnp.zeros(cache_shape, dt),
             **paged_state,
+            **spec_state,
             "tok": jnp.zeros((M,), i32),
             "pos": jnp.zeros((M,), i32),
             "prefill_left": jnp.zeros((M,), i32),
@@ -366,7 +456,10 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
                          block_ticks: Optional[int] = None,
                          eos_id: Optional[int] = None,
                          paged: bool = False, page_size: int = 8,
-                         n_pages: Optional[int] = None) -> ServingProgram:
+                         n_pages: Optional[int] = None,
+                         speculative: bool = False, gamma: int = 2,
+                         draft_cfg: Optional[ModelConfig] = None
+                         ) -> ServingProgram:
     """Build the serving tick-block program over ``mesh``'s pipe axis.
 
     ``n_slots`` is the ring's M (each slot carries one request);
@@ -387,6 +480,15 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
     :func:`...analysis.memory_model.size_page_pool` to trade worst-case
     reservation for admission backpressure (docs/serving.md "Paged KV
     cache & prefix caching").
+
+    ``speculative=True`` adds greedy draft-verify decoding: ``draft_cfg``
+    names a small model (same vocab, any depth/width) whose replicated
+    weights run on stage 0 inside the block; each decode visit proposes
+    ``gamma`` draft tokens and the target verifies all ``gamma + 1``
+    positions in one C-wide forward, so ``prefill_chunk`` must be at
+    least ``gamma + 1``. Composes with ``paged=True`` — target rows past
+    the accepted length stay uncommitted on the host allocator and are
+    rolled back by overwrite (docs/serving.md "Speculative decoding").
     """
     if cfg.arch not in ("gpt2", "llama"):
         raise ValueError(
@@ -408,11 +510,40 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
     if M < D:
         raise ValueError(f"n_slots={M} must be >= the pipe degree {D} "
                          "(fewer slots than stages stalls the ring)")
-    from ..analysis import maybe_verify_serving
-    maybe_verify_serving(D, M)
     C = prefill_chunk
     if C < 1:
         raise ValueError(f"prefill_chunk must be >= 1, got {C}")
+    if not speculative:
+        gamma = 0
+        draft_cfg = None
+    else:
+        if draft_cfg is None:
+            raise ValueError("speculative=True needs draft_cfg (the "
+                             "draft model's ModelConfig)")
+        if gamma < 1:
+            raise ValueError(f"gamma must be >= 1, got {gamma}")
+        if gamma + 1 > C:
+            raise ValueError(
+                f"speculative verify scores gamma+1={gamma + 1} positions "
+                f"through the C-wide chunk channel; set prefill_chunk >= "
+                f"gamma+1 (got prefill_chunk={C})")
+        if draft_cfg.arch not in ("gpt2", "llama"):
+            raise ValueError(f"draft arch {draft_cfg.arch!r} is not "
+                             "generable (see models.generate)")
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab_size ({draft_cfg.vocab_size}) must match the "
+                f"target's ({cfg.vocab_size}) — acceptance compares token "
+                "ids")
+        if draft_cfg.arch == "gpt2" \
+                and max_len + C - 1 > draft_cfg.max_seq_len:
+            raise ValueError(
+                f"max_len + prefill_chunk - 1 ({max_len + C - 1}) exceeds "
+                f"the gpt2 draft position table "
+                f"(max_seq_len={draft_cfg.max_seq_len})")
+    from ..analysis import maybe_verify_serving
+    maybe_verify_serving(D, M, gamma=gamma if speculative else None,
+                         prefill_chunk=C)
     if prompt_max < 1 or out_max < 1:
         raise ValueError("prompt_max and out_max must be >= 1")
     if prompt_max + 1 > max_len:
@@ -441,13 +572,33 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
         pmax = 0
         n_pages = 0
 
-    def spmd(layers_stacked, embed, head, state):
+    # column index where the paged page-table row starts inside meta:
+    # speculative mode inserts the isverify flag + gamma draft tokens
+    # between the base 4 columns and the page row
+    meta_pt = 4 + (1 + gamma if speculative else 0)
+    tok_w = gamma + 2 if speculative else 1
+
+    def spmd(*args):
+        if speculative:
+            (layers_stacked, embed, head,
+             dlayers_stacked, dembed, dhead, state) = args
+        else:
+            layers_stacked, embed, head, state = args
+            dlayers_stacked = dembed = dhead = None
         d = jax.lax.axis_index(PIPE_AXIS)
         layers_d = jax.tree.map(lambda x: x[0, 0], layers_stacked)
         layers_d = compute_cast(cfg, layers_d)
         embed_c = compute_cast(cfg, embed)
         head_c = compute_cast(cfg, head)
         dt = jnp.dtype(cfg.dtype)
+        if speculative:
+            # the draft is replicated: every stage traces it, only stage
+            # 0's cond branch executes it (no collectives inside)
+            dlayers = jax.tree.map(lambda x: x[0, 0], dlayers_stacked)
+            dlayers = compute_cast(draft_cfg, dlayers)
+            dembed_c = compute_cast(draft_cfg, dembed)
+            dhead_c = compute_cast(draft_cfg, dhead)
+            ddt = jnp.dtype(draft_cfg.dtype)
         perm = [(i, (i + 1) % D) for i in range(D)]
 
         def ring(tree):
@@ -461,30 +612,87 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
             kc, vc = st["kc"], st["vc"]
             is0 = d == 0
 
-            # ---- bank the token that rode in (meta came with it, so a
-            # dead or mid-prefill hop banks nothing). Banking runs BEFORE
-            # the serve so the M == D same-tick arrive/serve case sees
-            # fresh state.
+            # ---- bank the token(s) that rode in (meta came with them, so
+            # a dead or mid-prefill hop banks nothing). Banking runs
+            # BEFORE the serve so the M == D same-tick arrive/serve case
+            # sees fresh state.
             bank = is0 & (meta[2] == 1) & (meta[3] == 1)
             ga = jnp.mod(u - D, M)
-            tk = tok_chan[0]
-            em = st["emitted"][ga]
-            st["out_buf"] = jnp.where(
-                bank, st["out_buf"].at[ga, em].set(tk), st["out_buf"])
-            st["t_first"] = jnp.where(
-                bank & (em == 0), st["t_first"].at[ga].set(u), st["t_first"])
-            em2 = em + 1
-            fin_now = em2 >= st["budget"][ga]
-            if eos_id is not None:
-                fin_now = fin_now | (tk == eos_id)
-            st["finished"] = jnp.where(
-                bank, st["finished"].at[ga].set(st["finished"][ga] | fin_now),
-                st["finished"])
-            st["t_finish"] = jnp.where(
-                bank & fin_now, st["t_finish"].at[ga].set(u), st["t_finish"])
-            st["emitted"] = jnp.where(
-                bank, st["emitted"].at[ga].set(em2), st["emitted"])
-            st["tok"] = jnp.where(bank, st["tok"].at[ga].set(tk), st["tok"])
+            if speculative:
+                # a verify visit delivers up to gamma+1 accepted tokens at
+                # once; a prefill/catch-up visit delivers one (n_acc == 1
+                # rode the channel). The static gamma+1 loop banks token
+                # j only while j < n_acc and neither budget nor EOS has
+                # retired the slot mid-acceptance — the oracle stops at
+                # EOS, so accepted tokens past it must never land.
+                vflag = meta[4] == 1
+                n_acc = jnp.clip(tok_chan[gamma + 1], 1, gamma + 1)
+                em0 = st["emitted"][ga]
+                em_run = em0
+                fin_run = jnp.zeros((), bool)
+                out_buf, t_first = st["out_buf"], st["t_first"]
+                t_finish = st["t_finish"]
+                for j in range(gamma + 1):
+                    tk_j = tok_chan[j]
+                    do = bank & (j < n_acc) & ~fin_run
+                    out_buf = jnp.where(
+                        do, out_buf.at[ga, em_run].set(tk_j), out_buf)
+                    t_first = jnp.where(do & (em_run == 0),
+                                        t_first.at[ga].set(u), t_first)
+                    em_run = em_run + do.astype(i32)
+                    fin_tok = em_run >= st["budget"][ga]
+                    if eos_id is not None:
+                        fin_tok = fin_tok | (tk_j == eos_id)
+                    fin_now = do & fin_tok
+                    t_finish = jnp.where(fin_now, t_finish.at[ga].set(u),
+                                         t_finish)
+                    fin_run = fin_run | fin_now
+                st["out_buf"], st["t_first"] = out_buf, t_first
+                st["t_finish"] = t_finish
+                st["finished"] = jnp.where(
+                    bank,
+                    st["finished"].at[ga].set(st["finished"][ga] | fin_run),
+                    st["finished"])
+                st["emitted"] = jnp.where(
+                    bank, st["emitted"].at[ga].set(em_run), st["emitted"])
+                # the last banked token seeds the slot's next visit; a
+                # retired slot's value is never read
+                last = tok_chan[jnp.maximum(em_run - em0, 1) - 1]
+                st["tok"] = jnp.where(bank, st["tok"].at[ga].set(last),
+                                      st["tok"])
+                # verify visits advance the target/draft frontiers HERE
+                # (serve time could not know n_acc); rejected rows are
+                # left past the frontier for the next write to cover
+                padd = jnp.where(bank & vflag, n_acc, 0)
+                st["pos"] = st["pos"].at[ga].add(padd)
+                st["dpos"] = st["dpos"].at[ga].add(padd)
+                st["spec_visits"] = st["spec_visits"].at[ga].add(
+                    (bank & vflag).astype(i32))
+                st["spec_accepted"] = st["spec_accepted"].at[ga].add(
+                    jnp.where(bank & vflag, n_acc - 1, 0))
+            else:
+                tk = tok_chan[0]
+                em = st["emitted"][ga]
+                st["out_buf"] = jnp.where(
+                    bank, st["out_buf"].at[ga, em].set(tk), st["out_buf"])
+                st["t_first"] = jnp.where(
+                    bank & (em == 0), st["t_first"].at[ga].set(u),
+                    st["t_first"])
+                em2 = em + 1
+                fin_now = em2 >= st["budget"][ga]
+                if eos_id is not None:
+                    fin_now = fin_now | (tk == eos_id)
+                st["finished"] = jnp.where(
+                    bank,
+                    st["finished"].at[ga].set(st["finished"][ga] | fin_now),
+                    st["finished"])
+                st["t_finish"] = jnp.where(
+                    bank & fin_now, st["t_finish"].at[ga].set(u),
+                    st["t_finish"])
+                st["emitted"] = jnp.where(
+                    bank, st["emitted"].at[ga].set(em2), st["emitted"])
+                st["tok"] = jnp.where(bank, st["tok"].at[ga].set(tk),
+                                      st["tok"])
 
             # ---- serve slot g = u mod M. Stage 0 builds the metadata
             # from its slot tables; later stages replay the copy that
@@ -493,10 +701,90 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
             act0 = st["live"][g] & ~st["finished"][g]
             pleft = st["prefill_left"][g]
             ispre = pleft > 0
-            sv0 = jnp.where(ispre, jnp.minimum(pleft, C), 1)
             off0 = st["pos"][g]
+            if speculative:
+                # three visit kinds: chunked prefill (as ever), draft
+                # catch-up decode (the draft's frontier trails the
+                # target's — after a paged prefix skip the draft holds no
+                # KV for the matched tokens), and verify (frontiers
+                # aligned: propose gamma, score gamma+1)
+                dp0 = st["dpos"][g]
+                isver = (~ispre) & (dp0 >= off0)
+                sv0 = jnp.where(ispre, jnp.minimum(pleft, C),
+                                jnp.where(isver, gamma + 1, 1))
+            else:
+                isver = None
+                sv0 = jnp.where(ispre, jnp.minimum(pleft, C), 1)
             sf0 = jnp.where(ispre, (pleft <= C).astype(i32), 1)
-            meta0 = jnp.stack([off0, sv0, sf0, act0.astype(i32)])
+
+            if speculative:
+                # ---- the draft model's turn (stage 0 only). Catch-up
+                # visits feed it one C-wide chunk at its own frontier —
+                # token source spans the prompt then the already-banked
+                # output, so it converges on the target within a few
+                # visits. Verify visits run gamma sequential single-row
+                # steps from the last banked token; the proposals ride
+                # the metadata ring to the last stage for acceptance.
+                def draft_run(op):
+                    dk, dv = op
+
+                    def catchup(op2):
+                        dk, dv = op2
+                        hi = jnp.where(ispre, st["plen"][g], off0 + 1)
+                        dn = jnp.maximum(
+                            jnp.minimum(C, hi - dp0), 0)
+                        pp = dp0 + jnp.arange(C, dtype=i32)
+                        plen_g = st["plen"][g]
+                        from_prompt = jnp.take(
+                            st["prompt_buf"][g],
+                            jnp.clip(pp, 0,
+                                     st["prompt_buf"].shape[1] - 1))
+                        from_out = jnp.take(
+                            st["out_buf"][g],
+                            jnp.clip(pp - plen_g, 0, out_max - 1))
+                        toks = jnp.where(pp < plen_g, from_prompt,
+                                         from_out)[None]
+                        xd = _embed_at(draft_cfg, dembed_c, toks,
+                                       dp0).astype(ddt)
+                        _, dk, dv = _slot_cache_apply(
+                            draft_cfg, dlayers, xd, dk, dv, g, 1, dp0, C)
+                        return (dk, dv), jnp.zeros((gamma,), i32), dn
+
+                    def propose(op2):
+                        dk, dv = op2
+                        t = st["tok"][g]
+                        toks = []
+                        for i in range(gamma):
+                            xd = _embed_at(draft_cfg, dembed_c,
+                                           t[None, None],
+                                           dp0 + i).astype(ddt)
+                            yd, dk, dv = _slot_cache_apply(
+                                draft_cfg, dlayers, xd, dk, dv, g, 1,
+                                dp0 + i, 1)
+                            t = _head_token(draft_cfg, dhead_c, dembed_c,
+                                            yd, None)[0]
+                            toks.append(t)
+                        return ((dk, dv), jnp.stack(toks),
+                                jnp.zeros((), i32))
+
+                    return jax.lax.cond(ispre | (dp0 < off0), catchup,
+                                        propose, op)
+
+                def draft_noop(op):
+                    return op, jnp.zeros((gamma,), i32), jnp.zeros((), i32)
+
+                ((dkc_n, dvc_n), draft_toks, dadv) = jax.lax.cond(
+                    is0 & act0, draft_run, draft_noop,
+                    (st["dkc"], st["dvc"]))
+                st["dkc"], st["dvc"] = dkc_n, dvc_n
+                st["dpos"] = jnp.where(
+                    is0 & act0, st["dpos"].at[g].add(dadv), st["dpos"])
+                meta0 = jnp.concatenate([
+                    jnp.stack([off0, sv0, sf0, act0.astype(i32),
+                               isver.astype(i32)]), draft_toks])
+            else:
+                draft_toks = None
+                meta0 = jnp.stack([off0, sv0, sf0, act0.astype(i32)])
             if paged:
                 # the served slot's page-table row rides the ring with
                 # the metadata: stages d > 0 gather/scatter through the
@@ -507,24 +795,37 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
             offset, s_valid = meta_eff[0], meta_eff[1]
             active = meta_eff[3] == 1
 
-            # stage 0 consumes the slot's frontier for this visit
+            # stage 0 consumes the slot's frontier for this visit (verify
+            # visits advance at banking instead — n_acc is data there)
             upd = is0 & act0
-            st["pos"] = jnp.where(upd, st["pos"].at[g].set(off0 + sv0),
-                                  st["pos"])
+            if speculative:
+                adv = jnp.where(ispre, sv0, 1)
+                st["pos"] = jnp.where(upd & ~isver,
+                                      st["pos"].at[g].set(off0 + adv),
+                                      st["pos"])
+            else:
+                st["pos"] = jnp.where(upd, st["pos"].at[g].set(off0 + sv0),
+                                      st["pos"])
             st["prefill_left"] = jnp.where(
                 upd & ispre,
                 st["prefill_left"].at[g].set(pleft - sv0),
                 st["prefill_left"])
 
             # the C-token input: next prompt chunk while prefilling, the
-            # last banked token (plus C-1 junk rows) while decoding. The
-            # junk rows' cache writes land past the valid frontier and
-            # are overwritten before the frontier reaches them.
+            # last banked token (plus C-1 junk rows) while decoding, or
+            # [t0, d_1..d_gamma] on a verify visit. The junk rows' cache
+            # writes land past the valid frontier and are overwritten
+            # before the frontier reaches them.
             pstart = st["plen"][g] - pleft
             chunk = jax.lax.dynamic_slice(st["prompt_buf"][g],
                                           (jnp.maximum(pstart, 0),), (C,))
             dec = jnp.zeros((C,), i32).at[0].set(st["tok"][g])
-            toks_in = jnp.where(ispre, chunk, dec)[None]  # [1, C]
+            if speculative:
+                ver = jax.lax.dynamic_update_slice(dec, draft_toks, (1,))
+                toks_in = jnp.where(
+                    ispre, chunk, jnp.where(isver, ver, dec))[None]
+            else:
+                toks_in = jnp.where(ispre, chunk, dec)[None]  # [1, C]
             x0 = _embed_at(cfg, embed_c, toks_in, offset).astype(dt)
             x = jnp.where(is0, x0, h_chan)
 
@@ -532,24 +833,53 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
                 kc, vc = op
                 if paged:
                     y, kc, vc = _paged_cache_apply(cfg, layers_d, x, kc, vc,
-                                                   meta_eff[4:], offset, C,
+                                                   meta_eff[meta_pt:],
+                                                   offset, C,
                                                    tp_axis=tp_axis, tp_size=T)
                 else:
                     y, kc, vc = _slot_cache_apply(cfg, layers_d, x, kc, vc,
                                                   g, 1, offset, C,
                                                   tp_axis=tp_axis, tp_size=T)
-                y_last = jax.lax.dynamic_slice_in_dim(y, s_valid - 1, 1,
-                                                      axis=1)
-                tok = jax.lax.cond(
-                    (d == D - 1) & (meta_eff[2] == 1),
-                    lambda: _head_token(cfg, head_c, embed_c, y_last, None,
-                                        tp_axis=tp_axis, tp_size=T,
-                                        vocab_parallel=vocab_parallel),
-                    lambda: jnp.zeros((1,), i32))
+                if speculative:
+                    # score every chunk row in one batched head call (rows
+                    # become the batch dim, so the vocab-parallel
+                    # shard/all_gather path is reused unchanged), then
+                    # take the longest matching prefix of the proposals:
+                    # d_i is accepted while d_i == y_{i-1}, and y_n_acc-1
+                    # is the bonus token the target emits for free
+                    def head_all():
+                        return _head_token(cfg, head_c, embed_c,
+                                           jnp.swapaxes(y, 0, 1), None,
+                                           tp_axis=tp_axis, tp_size=T,
+                                           vocab_parallel=vocab_parallel)
+
+                    y_all = jax.lax.cond(
+                        (d == D - 1) & (meta_eff[2] == 1),
+                        head_all, lambda: jnp.zeros((C,), i32))
+                    isv = meta_eff[4] == 1
+                    drafts = meta_eff[5:5 + gamma]
+                    n_acc = jnp.where(isv, spec_accept_len(drafts, y_all),
+                                      1)
+                    dec_tok = jnp.take(y_all,
+                                       jnp.maximum(s_valid - 1, 0))
+                    ver_vec = jnp.concatenate([y_all[:gamma + 1],
+                                               n_acc[None]])
+                    dec_vec = jnp.zeros((tok_w,), i32) \
+                        .at[0].set(dec_tok).at[gamma + 1].set(1)
+                    tok = jnp.where(isv, ver_vec, dec_vec)
+                else:
+                    y_last = jax.lax.dynamic_slice_in_dim(y, s_valid - 1, 1,
+                                                          axis=1)
+                    tok = jax.lax.cond(
+                        (d == D - 1) & (meta_eff[2] == 1),
+                        lambda: _head_token(cfg, head_c, embed_c, y_last,
+                                            None, tp_axis=tp_axis, tp_size=T,
+                                            vocab_parallel=vocab_parallel),
+                        lambda: jnp.zeros((1,), i32))
                 return (kc, vc), y, tok
 
             def noop(op):
-                return op, jnp.zeros_like(h_chan), jnp.zeros((1,), i32)
+                return op, jnp.zeros_like(h_chan), jnp.zeros((tok_w,), i32)
 
             (kc, vc), y, tok = jax.lax.cond(active, unit, noop, (kc, vc))
             st["h"], st["tok_chan"], st["meta"] = ring((y, tok, meta_eff))
@@ -558,8 +888,10 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
             return st, None
 
         # per-device leaves arrive with a leading singleton shard dim
+        shard_keys = ("h", "tok_chan", "meta", "kc", "vc") + \
+            (("dkc", "dvc") if speculative else ())
         inner = dict(state)
-        for k in ("h", "tok_chan", "meta", "kc", "vc"):
+        for k in shard_keys:
             inner[k] = state[k][0]
         if paged:
             # execute the host's queued copy-on-write commands before any
@@ -587,12 +919,14 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
         # stage 0's slot tables are authoritative; replicate them so the
         # host (and the next block on every stage) sees one truth
         out = dict(inner)
-        for k in ("tok", "pos", "prefill_left", "emitted", "finished",
-                  "out_buf", "t_first", "t_finish"):
+        rep_keys = ("tok", "pos", "prefill_left", "emitted", "finished",
+                    "out_buf", "t_first", "t_finish") + \
+            (_SPEC_KEYS if speculative else ())
+        for k in rep_keys:
             v = inner[k]
             rep = jax.lax.psum(jnp.where(d == 0, v.astype(i32), 0), PIPE_AXIS)
             out[k] = rep.astype(v.dtype)
-        for k in ("h", "tok_chan", "meta", "kc", "vc"):
+        for k in shard_keys:
             out[k] = out[k][None]
         return out
 
@@ -612,20 +946,33 @@ def make_serving_step_fn(cfg: ModelConfig, mesh: Mesh, *, n_slots: int,
         # the pool itself reuses the kc/vc cache spec (same rank, the
         # model axis still shards the n_kv dim)
         state_spec.update({"page_tbl": P(), "cow_src": P(), "cow_dst": P()})
-    sharded = _shard_map(spmd, mesh,
-                         in_specs=(layer_spec, P(), P(), state_spec),
+    if speculative:
+        # the draft cache rides the pipe-axis shard slot like the target
+        # cache (only stage 0's shard holds data — the draft never runs
+        # under TP, so no model-axis dim); frontiers/counters are
+        # replicated stage-0-authoritative vectors like pos/emitted
+        state_spec.update({"dkc": P(PIPE_AXIS), "dvc": P(PIPE_AXIS),
+                           "dpos": P(), "spec_visits": P(),
+                           "spec_accepted": P()})
+        in_specs = (layer_spec, P(), P(), P(), P(), P(), state_spec)
+        donate = 6
+    else:
+        in_specs = (layer_spec, P(), P(), state_spec)
+        donate = 3
+    sharded = _shard_map(spmd, mesh, in_specs=in_specs,
                          out_specs=state_spec)
 
     # donate the state (caches included): the block is state -> state', so
     # XLA reuses the cache buffers instead of double-allocating them
-    step = jax.jit(sharded, donate_argnums=(3,))
+    step = jax.jit(sharded, donate_argnums=(donate,))
 
     return ServingProgram(cfg, mesh, n_slots=M, max_len=max_len,
                           prompt_max=prompt_max, out_max=out_max,
                           prefill_chunk=C, block_ticks=block, eos_id=eos_id,
                           step_fn=step, state_specs=state_spec,
                           paged=paged, page_size=page_size if paged else 0,
-                          n_pages=n_pages)
+                          n_pages=n_pages, speculative=speculative,
+                          gamma=gamma, draft_cfg=draft_cfg)
 
 
 class ServingEngine:
@@ -648,10 +995,10 @@ class ServingEngine:
     """
 
     def __init__(self, program: ServingProgram, params, *,
-                 report=None, fault_plan=None,
+                 draft_params=None, report=None, fault_plan=None,
                  prefix_cache: bool = True) -> None:
         self.program = program
-        self.weights = program.prepare(params)
+        self.weights = program.prepare(params, draft_params)
         self.report = report
         self.fault_plan = fault_plan
         self.prefix_cache = prefix_cache
@@ -678,6 +1025,9 @@ class ServingEngine:
         self.pages_used: List[Any] = []
         self.page_fragmentation: List[Any] = []
         self._n_backpressure = 0
+        self._spec_visits = 0
+        self._spec_accepted = 0
+        self.acceptance_series: List[Any] = []
         if self.program.paged:
             from .paging import PagedKVAllocator
             p = self.program
@@ -752,6 +1102,13 @@ class ServingEngine:
         self._dirty.update(("prompt_buf", "plen", "prefill_left", "pos",
                             "emitted", "budget", "tok", "out_buf", "t_first",
                             "t_finish", "finished", "live"))
+        if p.speculative:
+            # the draft starts cold even after a paged prefix skip (its
+            # KV was never cached) — catch-up visits close the gap
+            h["dpos"][slot] = 0
+            h["spec_visits"][slot] = 0
+            h["spec_accepted"][slot] = 0
+            self._dirty.update(_SPEC_KEYS)
         self._slot_req[slot] = req
         self._slot_admit[slot] = self._tick
         if self.report is not None:
@@ -821,10 +1178,19 @@ class ServingEngine:
                 self._dirty.add("page_tbl")
             del self._slot_req[slot]
             del self._slot_admit[slot]
+            spec_kv = {}
+            if self.program.speculative:
+                sv = int(host["spec_visits"][slot])
+                sa = int(host["spec_accepted"][slot])
+                self._spec_visits += sv
+                self._spec_accepted += sa
+                spec_kv = {"spec_verify_visits": sv, "spec_accepted": sa,
+                           "accepted_len_mean": (round(1 + sa / sv, 4)
+                                                 if sv else None)}
             if self.report is not None:
                 self.report.event("serve_finish", rid=req.rid, slot=slot,
                                   tick=self._tick, n_tokens=n,
-                                  ttft_ticks=comp.ttft_ticks)
+                                  ttft_ticks=comp.ttft_ticks, **spec_kv)
 
     def run(self, requests: Sequence[Request], *,
             policy: str = "continuous",
@@ -972,12 +1338,30 @@ class ServingEngine:
                 n_wait += 1
             self.queue_depth.append((self._tick, n_wait))
             if self.paging is not None:
-                self.pages_used.append((self._tick, self.paging.pages_used))
+                # the committed-frontier ledger follows pos, which only
+                # ever advances by ACCEPTED rows (speculative overshoot
+                # lands past it and is rolled back by overwrite), so
+                # commits, fragmentation and later trie inserts all see
+                # the accepted frontier only
                 frontier = {s: int(self.host["pos"][s])
                             for s in self._slot_req}
+                for s, f in frontier.items():
+                    self.paging.advance(s, f)
+                self.pages_used.append((self._tick, self.paging.pages_used))
                 self.page_fragmentation.append(
                     (self._tick,
                      round(self.paging.fragmentation(frontier), 6)))
+            if p.speculative:
+                # running acceptance rate at this boundary: harvested
+                # totals plus the still-bound slots' live counters
+                tv = self._spec_visits + sum(
+                    int(self.host["spec_visits"][s]) for s in self._slot_req)
+                ta = self._spec_accepted + sum(
+                    int(self.host["spec_accepted"][s])
+                    for s in self._slot_req)
+                self.acceptance_series.append(
+                    (self._tick,
+                     round(ta / (p.gamma * tv), 6) if tv else None))
             self._harvest()
             free = [g for g in range(p.n_slots) if g not in self._slot_req]
         else:
@@ -995,11 +1379,18 @@ class ServingEngine:
                 prefill_skipped_tokens=self.paging.matched_tokens,
                 n_cow=self.paging.n_cow,
                 n_backpressure=self._n_backpressure)
+        spec_kv: Dict[str, Any] = {}
+        if p.speculative:
+            spec_kv = dict(speculative=True, gamma=p.gamma,
+                           spec_verify_visits=self._spec_visits,
+                           spec_accepted_tokens=self._spec_accepted,
+                           acceptance_series=self.acceptance_series)
         result = ServeResult(completions=self.completions,
                              occupancy=self.occupancy, ticks=self._tick,
                              wall_s=wall, n_slots=p.n_slots, policy=policy,
                              queue_depth=self.queue_depth,
-                             busy_ticks=self._busy_ticks, **paged_kv)
+                             busy_ticks=self._busy_ticks, **paged_kv,
+                             **spec_kv)
         if self.report is not None:
             # one event per run with the measured tick rate — the factor
             # the cost model's predicted per-tick time reconciles against
@@ -1012,5 +1403,9 @@ class ServingEngine:
                 **({"prefix_hit_rate": result.prefix_hit_rate,
                     "n_backpressure": result.n_backpressure,
                     "n_cow": result.n_cow} if self.paging is not None
-                   else {}))
+                   else {}),
+                **({"gamma": p.gamma,
+                    "acceptance_rate": result.acceptance_rate,
+                    "accepted_len_mean": result.accepted_len_mean}
+                   if p.speculative else {}))
         return result

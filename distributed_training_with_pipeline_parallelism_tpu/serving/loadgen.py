@@ -182,6 +182,13 @@ def _point_row(load: float, summary: Dict[str, Any],
                 "prefill_skipped_tokens", "n_cow", "n_backpressure"):
         if summary.get(key) is not None:
             row[key] = summary[key]
+    # speculative gauges likewise (presence marks a spec-on curve; a
+    # point that finished before its first verify keeps acceptance_rate
+    # None rather than dropping the column)
+    if summary.get("speculative"):
+        for key in ("gamma", "acceptance_rate", "accepted_len_mean",
+                    "spec_verify_visits"):
+            row[key] = summary.get(key)
     if slo_point is not None:
         row["slo"] = slo_point
     return row
@@ -233,9 +240,10 @@ def sweep_offered_load(engine: ServingEngine, loads: Sequence[float], *,
         # the roofline's per-tick prediction is load-independent (the
         # ring rolls every tick); computing it per point pins the
         # reconciliation to each point's measured s_per_tick
-        cm = serving_cost_model_section(cfg, program.n_stages,
-                                        program.n_slots, summary,
-                                        hardware=hardware)
+        cm = serving_cost_model_section(
+            cfg, program.n_stages, program.n_slots, summary,
+            hardware=hardware,
+            draft_cfg=getattr(program, "draft_cfg", None))
         rows.append(_point_row(load, summary,
                                cm["predicted"]["step_s"],
                                slo_attainment(result, slo)))

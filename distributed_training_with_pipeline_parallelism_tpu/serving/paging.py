@@ -264,6 +264,13 @@ class PagedKVAllocator:
         self.max_pages_per_slot = int(max_pages_per_slot)
         self.prefill_chunk = int(prefill_chunk)
         self._bound: Dict[int, AdmissionPlan] = {}
+        # committed-frontier ledger (speculative append/rollback): rows
+        # the device has ACCEPTED per bound slot. A speculative verify
+        # writes up to C rows past this frontier, but the engine only
+        # ever advances the ledger by the accepted length — overshoot
+        # rows stay uncommitted, get rolled back by overwrite, and the
+        # radix trie never caches a page that is not fully committed
+        self._committed: Dict[int, int] = {}
         # COW source pages held live until the device executes the copy
         # (the next block): without the hold, a concurrent admission's
         # trie eviction could free the source before the copy runs
@@ -334,10 +341,45 @@ class PagedKVAllocator:
         if slot in self._bound:
             raise ValueError(f"slot {slot} already bound")
         self._bound[slot] = plan
+        # the cached prefix is committed on arrival (those rows hold
+        # verified tokens from a previous request); everything past it
+        # commits only as the device accepts it
+        self._committed[slot] = plan.matched_len
         self.n_admitted += 1
         self.n_cow += int(plan.cow_dst >= 0)
         self.matched_tokens += plan.matched_len
         self.prompt_tokens += plan.plen
+
+    def advance(self, slot: int, frontier: int) -> None:
+        """Commit a bound slot's pages up to ``frontier`` accepted rows.
+
+        The engine calls this with the slot's post-block ``pos`` — which
+        advances only by prefill chunks and *accepted* speculative
+        tokens, never by rejected overshoot. The ledger enforces the
+        rollback contract: commits are monotone (a retreat would mean
+        already-committed rows were overwritten) and bounded by the
+        slot's page reservation (an overshoot past it would mean the
+        device wrote rows no page backs)."""
+        plan = self._bound.get(slot)
+        if plan is None:
+            raise ValueError(f"advance on unbound slot {slot}")
+        have = self._committed[slot]
+        if frontier < have:
+            raise ValueError(
+                f"slot {slot}: committed frontier moved backwards "
+                f"({have} -> {frontier}) — speculative rollback must "
+                "never touch committed rows")
+        cap = plan.n_pages * self.pool.page_size
+        if frontier > cap:
+            raise ValueError(
+                f"slot {slot}: frontier {frontier} exceeds the slot's "
+                f"page reservation ({plan.n_pages} pages x "
+                f"{self.pool.page_size} rows)")
+        self._committed[slot] = frontier
+
+    def committed_rows(self, slot: int) -> int:
+        """Accepted rows committed for a bound slot (0 if unbound)."""
+        return self._committed.get(slot, 0)
 
     def release_plan(self, plan: AdmissionPlan) -> None:
         """Undo ``try_admit`` for a plan that never ran (failed
@@ -349,15 +391,21 @@ class PagedKVAllocator:
     def release(self, slot: int) -> None:
         """Scrub path: return a bound slot's pages without caching."""
         plan = self._bound.pop(slot, None)
+        self._committed.pop(slot, None)
         if plan is not None:
             self.release_plan(plan)
 
     def retire(self, slot: int, prompt: Sequence[int]) -> None:
         """Completion path: feed the prefix cache (insert before decref
-        so cached pages stay live), then return the slot's pages."""
+        so cached pages stay live), then return the slot's pages. Only
+        committed prompt rows are cacheable: speculative overshoot never
+        reaches the trie because the insert is capped at the committed
+        frontier (a finished slot has committed its whole prompt, so the
+        cap bites only if the ledger was never advanced)."""
         plan = self._bound.pop(slot)
+        committed = self._committed.pop(slot, plan.plen)
         if self.cache is not None:
-            self.cache.insert(prompt, plan.plen, plan.pages)
+            self.cache.insert(prompt, min(plan.plen, committed), plan.pages)
         self.release_plan(plan)
 
     def cow_flush(self) -> None:
@@ -408,6 +456,10 @@ class PagedKVAllocator:
             raise AssertionError("check_invariants on a non-drained "
                                  f"allocator (bound={sorted(self._bound)}, "
                                  f"cow_holds={self._cow_holds})")
+        if self._committed:
+            raise AssertionError(
+                "committed-frontier ledger leaked entries for slots "
+                f"{sorted(self._committed)} past drain")
         live = {int(p) for p in np.nonzero(self.pool.refcount)[0]}
         expected = {PAGE_NULL}
         if self.cache is not None:

@@ -686,6 +686,7 @@ def perfetto_serving_load_events(serving_events: List[Dict[str, Any]],
                                  s_per_tick: Optional[float] = None,
                                  pages_used: Optional[List[Any]] = None,
                                  page_fragmentation: Optional[List[Any]] = None,
+                                 acceptance: Optional[List[Any]] = None,
                                  pid: int = 3) -> List[Dict[str, Any]]:
     """The serving-load debugging surface on the **tick clock**: per-slot
     request slices split into *queue wait* vs *execution* sub-spans, plus
@@ -708,7 +709,13 @@ def perfetto_serving_load_events(serving_events: List[Dict[str, Any]],
     ``page fragmentation`` counter tracks from the same block-boundary
     samples (``ServeResult.pages_used``/``.page_fragmentation``), so a
     TTFT blow-up under prefix traffic decomposes into queue pressure vs
-    page-pool pressure on one screen."""
+    page-pool pressure on one screen. Speculative runs add an
+    ``acceptance rate`` counter track from ``(tick, alpha)`` samples
+    (``ServeResult.acceptance_series``) and nest a ``verify`` sub-span
+    under each finished request's serve slice carrying its
+    draft-verify gauges (``spec_verify_visits``/``spec_accepted``/
+    ``accepted_len_mean`` from the finish row), so an acceptance-rate
+    sag lines up with the exact requests it slowed."""
     admits: Dict[Any, Dict[str, Any]] = {}
     finishes: Dict[Any, Dict[str, Any]] = {}
     for row in serving_events or []:
@@ -758,6 +765,20 @@ def perfetto_serving_load_events(serving_events: List[Dict[str, Any]],
                     "ts": admit_tick * tick_us,
                     "dur": max(end_tick - admit_tick, 0.0) * tick_us,
                     "args": fargs})
+        # draft-verify sub-span: equal-duration slice emitted after the
+        # serve slice nests under it in the UI; args carry the
+        # per-request speculative gauges from the finish row
+        if fin is not None and fin.get("spec_verify_visits"):
+            out.append({
+                "ph": "X", "name": f"verify r{rid} "
+                f"x{int(fin['spec_verify_visits'])}",
+                "cat": "spec_verify", "pid": pid, "tid": slot + 1,
+                "ts": admit_tick * tick_us,
+                "dur": max(end_tick - admit_tick, 0.0) * tick_us,
+                "args": {"rid": rid,
+                         "spec_verify_visits": fin.get("spec_verify_visits"),
+                         "spec_accepted": fin.get("spec_accepted"),
+                         "accepted_len_mean": fin.get("accepted_len_mean")}})
     for name, series in (("slot occupancy", occupancy),
                          ("queue depth", queue_depth),
                          ("pages used", pages_used)):
@@ -770,6 +791,13 @@ def perfetto_serving_load_events(serving_events: List[Dict[str, Any]],
                     "cat": "serving_load", "pid": pid, "tid": 0,
                     "ts": float(t) * tick_us,
                     "args": {"page_fragmentation": float(f)}})
+    for t, a in acceptance or []:
+        if a is None:
+            continue  # pre-first-verify samples carry no rate yet
+        out.append({"ph": "C", "name": "acceptance rate",
+                    "cat": "serving_load", "pid": pid, "tid": 0,
+                    "ts": float(t) * tick_us,
+                    "args": {"acceptance_rate": float(a)}})
     return out
 
 
@@ -851,7 +879,8 @@ def write_perfetto_trace(telemetry: Optional[PipelineTelemetry], path: str,
             s_per_tick=serving_load_tracks.get("s_per_tick"),
             pages_used=serving_load_tracks.get("pages_used"),
             page_fragmentation=serving_load_tracks.get(
-                "page_fragmentation")))
+                "page_fragmentation"),
+            acceptance=serving_load_tracks.get("acceptance")))
     with open(path, "w") as fh:
         json.dump(trace, fh)
     return path
@@ -927,6 +956,7 @@ def serving_summary(result) -> Dict[str, Any]:
         "queue_depth_max": int(max(qd)) if qd else 0,
         "queue_depth": [[int(t), int(n)] for t, n in qd_series],
         **_paged_summary_fields(result),
+        **_spec_summary_fields(result),
     }
 
 
@@ -952,6 +982,29 @@ def _paged_summary_fields(result) -> Dict[str, Any]:
         "prefill_skipped_tokens": int(result.prefill_skipped_tokens),
         "n_cow": int(result.n_cow),
         "n_backpressure": int(result.n_backpressure),
+    }
+
+
+def _spec_summary_fields(result) -> Dict[str, Any]:
+    """Speculative-decoding gauges for :func:`serving_summary` — empty
+    dict for non-speculative runs (their summaries stay byte-identical).
+    ``acceptance_rate``/``accepted_len_mean`` are ``None`` rather than a
+    division error when a run finished before its first verify tick
+    (zero-finished sweep points included)."""
+    if not getattr(result, "speculative", False):
+        return {}
+    series = list(getattr(result, "acceptance_series", []) or [])
+    rate = result.acceptance_rate
+    alm = result.accepted_len_mean
+    return {
+        "speculative": True,
+        "gamma": int(result.gamma),
+        "spec_verify_visits": int(result.spec_verify_visits),
+        "spec_accepted_tokens": int(result.spec_accepted_tokens),
+        "acceptance_rate": float(rate) if rate is not None else None,
+        "accepted_len_mean": float(alm) if alm is not None else None,
+        "acceptance_series": [[int(t), (float(a) if a is not None else None)]
+                              for t, a in series],
     }
 
 

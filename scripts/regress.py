@@ -27,7 +27,10 @@ the same (name, backend, schedule) group:
   admission change that quietly stops sharing prefixes fails here even
   while correctness tests still pass (the hit rate is deterministic on
   the seeded prefix mix, so off-cpu it gates hard; cpu-proxy stays
-  warn-only like everything else),
+  warn-only like everything else). Speculative runs add
+  ``acceptance_rate`` (drop), ``spec_tokens_per_sec`` (drop) and
+  ``spec_tick_gain`` (drop — the tick-domain capacity headline of the
+  serve_spec leg) under the same discipline,
 - ``overlap_tokens_per_sec`` (bench's ``overlap_on`` pair row — the
   double-buffered ring executor, docs/performance.md "Comm/compute
   overlap") drops by more than the threshold: a change that silently
@@ -127,6 +130,9 @@ def extract_metrics(manifest) -> dict:
             "serve_ttft_p99_ref": None,
             "prefix_hit_rate": None,
             "overlap_tokens_per_sec": None,
+            "acceptance_rate": None,
+            "spec_tokens_per_sec": None,
+            "spec_tick_gain": None,
             "rel_err": None,
             "abs_rel_err": None,
             "calib_abs_err_raw": None,
@@ -191,6 +197,30 @@ def extract_metrics(manifest) -> dict:
                 prefix_hit = v
     if prefix_hit is None:
         prefix_hit = _num(gauges.get("prefix_hit_rate"))
+    # speculative-decoding gauges (docs/serving.md "Speculative
+    # decoding"): acceptance rate via the same cascade as the prefix hit
+    # rate — sweep curve rows, then serving summaries, then gauges.
+    # Deterministic on a seeded trace, so it gates hard off-cpu; the
+    # spec-on throughput / tick-gain headlines ride the gauges the
+    # serve_spec leg records. None on non-speculative runs -> no prior
+    # -> never gated.
+    acceptance = None
+    if isinstance(sl, dict):
+        for r in sl.get("curve") or []:
+            v = _num(r.get("acceptance_rate")) if isinstance(r, dict) \
+                else None
+            if v is not None:
+                acceptance = v if acceptance is None else max(acceptance, v)
+    if acceptance is None:
+        for r in manifest.get("serving") or []:
+            v = _num(r.get("acceptance_rate")) if isinstance(r, dict) \
+                else None
+            if v is not None:
+                acceptance = v
+    if acceptance is None:
+        acceptance = _num(gauges.get("acceptance_rate"))
+    spec_tps = _num(gauges.get("spec_on_tokens_per_sec"))
+    spec_tick_gain = _num(gauges.get("spec_tick_gain"))
     # comm/compute overlap pair (bench.py): the overlap-on throughput is
     # guarded like the headline; on a cpu-proxy backend all throughput
     # gates are already warn-only, so the jittery serialized-tick number
@@ -231,6 +261,9 @@ def extract_metrics(manifest) -> dict:
         "serve_ttft_p99_ref": ttft_ref,
         "prefix_hit_rate": prefix_hit,
         "overlap_tokens_per_sec": overlap_tps,
+        "acceptance_rate": acceptance,
+        "spec_tokens_per_sec": spec_tps,
+        "spec_tick_gain": spec_tick_gain,
         "rel_err": rel_err,
         "abs_rel_err": abs(rel_err) if rel_err is not None else None,
         "calib_abs_err_raw": _num(_get(cal, "summary",
@@ -287,6 +320,13 @@ def check(row, history, threshold, window) -> list:
                            ("serve_ttft_p99_ref", "up"),
                            ("prefix_hit_rate", "down"),
                            ("overlap_tokens_per_sec", "down"),
+                           # speculative guards: a draft/verify change
+                           # that quietly rejects more proposals or
+                           # shrinks the tick-domain capacity win fails
+                           # here (cpu-proxy: warn-only as always)
+                           ("acceptance_rate", "down"),
+                           ("spec_tokens_per_sec", "down"),
+                           ("spec_tick_gain", "down"),
                            # model-trust guards: prediction error may not
                            # quietly grow (missing in pre-calibration
                            # history rows -> no prior -> skip)

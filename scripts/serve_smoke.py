@@ -13,6 +13,9 @@ artifact): drive a small request mix through the slot-level
 - the paged-KV engine (page-pool gather + host-side radix/COW
   admission, same geometry) emits tokens bit-identical to the
   contiguous run on one compiled block,
+- the speculative draft-verify engine (self-draft, gamma=1) emits
+  tokens bit-identical to the contiguous run on one compiled block,
+  with at least one verify visit measured,
 - the paged-vs-contiguous comparison at a matched per-device HBM
   budget (``run_paged_bench`` on the shared-prefix mix) admits at
   least as many slots, matches completions across engines, and shows a
@@ -23,9 +26,9 @@ artifact): drive a small request mix through the slot-level
 
 Writes ``report.json`` (+ ``events.jsonl``) and ``paged_compare.json``
 into the output directory (argv[1], default ``/tmp/serve_smoke``) and
-exits 0 on success, 1 with a reason on any violation. Five small
-compiles (contiguous + paged serving blocks, oracle, the comparison's
-two engines): target a couple of minutes on a CI host.
+exits 0 on success, 1 with a reason on any violation. Six small
+compiles (contiguous + paged + speculative serving blocks, oracle, the
+comparison's two engines): target a couple of minutes on a CI host.
 """
 
 import os
@@ -137,6 +140,33 @@ def main() -> int:
     paged_engine.paging.check_invariants()  # raises on any page leak
     report.attach_serving(serving_summary(paged_res))
 
+    # speculative parity: the draft-verify engine (self-draft, gamma=1 —
+    # the widest draft this geometry's prefill_chunk=2 admits) on the
+    # same geometry must be bit-identical to the contiguous run — greedy
+    # acceptance only ever banks tokens the target itself argmaxed
+    spec_prog = make_serving_step_fn(cfg, mesh, n_slots=3, max_len=32,
+                                     prompt_max=8, out_max=10,
+                                     prefill_chunk=2, eos_id=EOS,
+                                     speculative=True, gamma=1,
+                                     draft_cfg=cfg)
+    spec_engine = ServingEngine(spec_prog, params, draft_params=params,
+                                report=report)
+    spec_res = spec_engine.run(requests, policy="continuous")
+    if any(cont_by_rid.get(c.rid) != c.tokens
+           for c in spec_res.completions):
+        print("serve_smoke: speculative engine emitted different tokens "
+              "than plain", file=sys.stderr)
+        return 1
+    if spec_prog.step._cache_size() != 1:
+        print(f"serve_smoke: speculative block compiled "
+              f"{spec_prog.step._cache_size()}x (want 1)", file=sys.stderr)
+        return 1
+    if not spec_res.spec_verify_visits:
+        print("serve_smoke: speculative run never reached a verify visit",
+              file=sys.stderr)
+        return 1
+    report.attach_serving(serving_summary(spec_res))
+
     # the ISSUE 19 headline: paged vs contiguous at a matched HBM budget
     # on the shared-prefix mix, reusing this smoke's weights (two more
     # small compiles); the row is the CI artifact regress/plot consumers
@@ -178,13 +208,18 @@ def main() -> int:
     manifest = report.write()
     validate_report(manifest)  # write() validates too; belt and suspenders
     rows = manifest.get("serving", [])
-    if len(rows) != 3 or rows[0]["ttft_ticks"]["p50"] is None:
+    if len(rows) != 4 or rows[0]["ttft_ticks"]["p50"] is None:
         print("serve_smoke: serving section missing or empty",
               file=sys.stderr)
         return 1
     if not rows[2].get("paged") or "prefix_hit_rate" not in rows[2]:
         print("serve_smoke: paged serving row lost its page gauges",
               file=sys.stderr)
+        return 1
+    if not rows[3].get("speculative") \
+            or rows[3].get("acceptance_rate") is None:
+        print("serve_smoke: speculative serving row lost its acceptance "
+              "gauges", file=sys.stderr)
         return 1
     if "memory" not in manifest or not manifest["memory"]["analytic"].get(
             "kv_cache_bytes_per_device"):

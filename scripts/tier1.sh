@@ -144,6 +144,25 @@ if ! timeout -k 10 60 \
   exit 1
 fi
 echo "SERVE_LOAD_PAGED=ok"
+# Speculative-decoding leg (ISSUE 20): paired spec-off/on bench on one
+# trace — completions must be bit-identical (greedy acceptance is
+# exact), both blocks compile once, and self-draft must land a
+# tick-domain capacity win (deterministic on the CPU proxy). The
+# acceptance rate, spec-on throughput and tick gain feed the regression
+# history under the serve_spec group, warn-only on cpu
+# (docs/serving.md "Speculative decoding").
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python scripts/serve_spec.py /tmp/serve_spec; then
+  echo "SERVE_SPEC=fail"
+  exit 1
+fi
+if ! timeout -k 10 60 \
+    python scripts/regress.py --report /tmp/serve_spec/report.json \
+    --history results/history.jsonl --warn-only; then
+  echo "SERVE_SPEC=fail"
+  exit 1
+fi
+echo "SERVE_SPEC=ok"
 # Comm/compute overlap leg (own budget): the overlap grid check prices
 # every registered schedule in the cost model's comm_overlap mode and
 # pins the step_s_overlapped <= step_s_comm_overlap <= step_s sandwich

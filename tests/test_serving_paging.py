@@ -114,6 +114,10 @@ def test_allocator_admit_retire_rematch():
     assert plan is not None and plan.matched_len == 0
     assert plan.n_pages == pages_for(len(prompt) + 4 + 1, 2)
     alloc.bind(0, plan)
+    # the engine commits rows as it accepts them (speculative rollback
+    # discipline); retire only caches committed prompt rows, so an
+    # unadvanced retire would cache nothing
+    alloc.advance(0, len(prompt))
     alloc.retire(0, prompt)
     # the identical prompt now matches its cached prefix chunks; the
     # last prompt token is always recomputed, so matched_len is capped
@@ -124,6 +128,7 @@ def test_allocator_admit_retire_rematch():
     assert plan2.cow_dst > 0  # divergence mid-chunk -> COW
     alloc.bind(1, plan2)
     alloc.cow_flush()
+    alloc.advance(1, len(prompt))
     alloc.retire(1, prompt)
     alloc.cow_flush()
     assert alloc.prefix_hit_rate() > 0

@@ -1,0 +1,372 @@
+"""Speculative decoding (ISSUE 20): draft-verify serving. The
+load-bearing property is BIT-PARITY — greedy speculative completions
+must be identical to the non-speculative engine on the same weights
+(acceptance only ever banks tokens the target itself argmaxed), across
+gpt2 and llama, PP and TP x PP meshes, with a real (disagreeing) draft
+model and with self-draft — plus the paged committed-frontier rollback
+discipline, the widened-metadata table checks, the acceptance math, the
+one-compilation pin, and zero-finished summary hardening."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import distributed_training_with_pipeline_parallelism_tpu as dtpp
+from distributed_training_with_pipeline_parallelism_tpu.models import (
+    transformer as tfm)
+from distributed_training_with_pipeline_parallelism_tpu.parallel.mesh import (
+    make_mesh)
+from distributed_training_with_pipeline_parallelism_tpu.parallel.pipelined_decode import (  # noqa: E501
+    spec_accept_len)
+from distributed_training_with_pipeline_parallelism_tpu.serving import (
+    Request, ServingEngine, make_serving_step_fn)
+
+EOS = 7
+
+
+def _cfg(arch="gpt2", **kw):
+    base = dict(dim=32, n_layers=4, n_heads=4, vocab_size=64, ffn_dim=64,
+                max_seq_len=64, arch=arch)
+    base.update(kw)
+    return dtpp.ModelConfig(**base)
+
+
+def _requests(cfg, n, seed=0, prompt_max=8, out_max=10, spacing=2.0):
+    rng = np.random.RandomState(seed)
+    return [Request(rid=i,
+                    prompt=rng.randint(0, cfg.vocab_size,
+                                       size=int(rng.randint(1, prompt_max)))
+                    .tolist(),
+                    max_new_tokens=int(rng.randint(1, out_max + 1)),
+                    arrival=float(i) * spacing)
+            for i in range(n)]
+
+
+def _by_rid(res):
+    return {c.rid: c.tokens for c in res.completions}
+
+
+# ---------------------------------------------------------------------------
+# acceptance math
+# ---------------------------------------------------------------------------
+
+
+def test_spec_accept_len_units():
+    """Longest-matching-prefix: 1 + run-length of draft==target, stopped
+    at the first mismatch regardless of later coincidental matches."""
+    assert int(spec_accept_len(np.array([5, 9]), np.array([5, 9, 3]))) == 3
+    assert int(spec_accept_len(np.array([5, 9]), np.array([5, 2, 3]))) == 2
+    assert int(spec_accept_len(np.array([4, 9]), np.array([5, 9, 3]))) == 1
+    # mismatch at 0 must gate position 1 even though drafts[1]==targets[1]
+    assert int(spec_accept_len(np.array([4, 9]), np.array([5, 9, 9]))) == 1
+    assert int(spec_accept_len(np.array([7]), np.array([7, 7]))) == 2
+
+
+def test_expected_tokens_per_verify():
+    from distributed_training_with_pipeline_parallelism_tpu.analysis import (
+        expected_tokens_per_verify)
+    assert expected_tokens_per_verify(0.0, 3) == 1.0
+    assert expected_tokens_per_verify(1.0, 3) == 4.0
+    # geometric series: (1 - 0.5^3) / (1 - 0.5) = 1.75
+    assert expected_tokens_per_verify(0.5, 2) == pytest.approx(1.75)
+    # clipped inputs and continuity toward alpha=1
+    assert expected_tokens_per_verify(1.5, 2) == 3.0
+    assert expected_tokens_per_verify(0.999999, 2) == pytest.approx(
+        3.0, abs=1e-4)
+    with pytest.raises(ValueError):
+        expected_tokens_per_verify(0.5, -1)
+
+
+# ---------------------------------------------------------------------------
+# table checks: widened speculative metadata
+# ---------------------------------------------------------------------------
+
+
+def test_speculative_hazard_kinds():
+    from distributed_training_with_pipeline_parallelism_tpu.analysis import (
+        speculative_hazards)
+
+    def kinds(**kw):
+        return sorted({h.kind for h in speculative_hazards(**kw)})
+
+    assert kinds(gamma=2, prefill_chunk=3) == []
+    assert kinds(gamma=0, prefill_chunk=3) == ["spec-gamma-oob"]
+    # verify chunk gamma+1 must fit the channel width C
+    assert kinds(gamma=3, prefill_chunk=3) == ["spec-gamma-oob"]
+    ok = dict(slot=0, n_accepted=2, pos=6, committed=6, mapped_rows=12)
+    assert kinds(gamma=2, prefill_chunk=3, slots=[ok]) == []
+    assert kinds(gamma=2, prefill_chunk=3,
+                 slots=[{**ok, "n_accepted": 4}]) == ["spec-accept-oob"]
+    assert kinds(gamma=2, prefill_chunk=3,
+                 slots=[{**ok, "n_accepted": 0}]) == ["spec-accept-oob"]
+    # committed frontier past the accepted position = overshoot leaked
+    assert kinds(gamma=2, prefill_chunk=3,
+                 slots=[{**ok, "committed": 7}]) == ["spec-commit-overrun"]
+    # verify chunk's junk tail past the mapped page span
+    assert kinds(gamma=2, prefill_chunk=3,
+                 slots=[{**ok, "mapped_rows": 8}]) == ["spec-draft-overrun"]
+
+
+def test_check_serving_ring_merges_speculative():
+    from distributed_training_with_pipeline_parallelism_tpu.analysis import (
+        check_serving_ring)
+    good = check_serving_ring(2, 4, speculative=dict(
+        gamma=2, prefill_chunk=3,
+        slots=[{"slot": 0, "n_accepted": 3, "pos": 9, "committed": 9,
+                "mapped_rows": 16}]))
+    assert good.ok
+    bad = check_serving_ring(2, 4, speculative=dict(
+        gamma=2, prefill_chunk=2))
+    assert not bad.ok
+    assert {h.kind for h in bad.hazards} == {"spec-gamma-oob"}
+
+
+def test_build_time_hook_rejects_oversized_gamma():
+    """make_serving_step_fn must reject gamma+1 > prefill_chunk (the
+    rollback-by-overwrite discipline needs the next C-wide write to
+    cover every overshoot row), and spec mode without a draft config."""
+    cfg = _cfg()
+    mesh = make_mesh(n_pipe=2)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        make_serving_step_fn(cfg, mesh, n_slots=2, max_len=16,
+                             prompt_max=6, out_max=6, prefill_chunk=2,
+                             eos_id=EOS, speculative=True, gamma=2,
+                             draft_cfg=cfg)
+    with pytest.raises(ValueError, match="draft_cfg"):
+        make_serving_step_fn(cfg, mesh, n_slots=2, max_len=16,
+                             prompt_max=6, out_max=6, prefill_chunk=2,
+                             eos_id=EOS, speculative=True, gamma=1)
+    with pytest.raises(ValueError, match="vocab"):
+        make_serving_step_fn(cfg, mesh, n_slots=2, max_len=16,
+                             prompt_max=6, out_max=6, prefill_chunk=2,
+                             eos_id=EOS, speculative=True, gamma=1,
+                             draft_cfg=_cfg(vocab_size=32))
+
+
+# ---------------------------------------------------------------------------
+# bit-parity: spec-on vs spec-off greedy
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("arch,kw", [
+    ("gpt2", {}),
+    ("llama", dict(n_kv_heads=2)),
+])
+def test_spec_parity_random_draft(arch, kw):
+    """A randomly-initialized draft disagrees with the target almost
+    everywhere — acceptance is near zero, and the completions must STILL
+    be bit-identical to the plain engine: rejected drafts are rolled
+    back by overwrite, never banked. The key correctness property."""
+    cfg = _cfg(arch, **kw)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    dcfg = _cfg(arch, dim=16, n_layers=2, n_heads=2, ffn_dim=32, **kw)
+    dparams = tfm.transformer_init(jax.random.key(99), dcfg)
+    mesh = make_mesh(n_pipe=2)
+    base = make_serving_step_fn(cfg, mesh, n_slots=3, max_len=24,
+                                prompt_max=8, out_max=10,
+                                prefill_chunk=3, eos_id=EOS)
+    spec = make_serving_step_fn(cfg, mesh, n_slots=3, max_len=24,
+                                prompt_max=8, out_max=10,
+                                prefill_chunk=3, eos_id=EOS,
+                                speculative=True, gamma=2, draft_cfg=dcfg)
+    requests = _requests(cfg, 5, seed=3)
+    res0 = ServingEngine(base, params).run(requests, policy="continuous")
+    eng1 = ServingEngine(spec, params, draft_params=dparams)
+    res1 = eng1.run(requests, policy="continuous")
+    assert _by_rid(res1) == _by_rid(res0)
+    # one-compilation pin: the data-dependent accepted length must ride
+    # the widened metadata ring, never a host-visible shape
+    assert spec.step._cache_size() == 1
+    assert res1.spec_verify_visits > 0
+
+
+def test_spec_parity_self_draft_wins_ticks():
+    """Self-draft (draft == target) pins acceptance high, so the run
+    must finish in strictly fewer ticks than the plain engine — the
+    deterministic tick-domain capacity win — while staying
+    bit-identical."""
+    cfg = _cfg()
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    mesh = make_mesh(n_pipe=2)
+    base = make_serving_step_fn(cfg, mesh, n_slots=3, max_len=24,
+                                prompt_max=8, out_max=10,
+                                prefill_chunk=3, eos_id=EOS)
+    spec = make_serving_step_fn(cfg, mesh, n_slots=3, max_len=24,
+                                prompt_max=8, out_max=10,
+                                prefill_chunk=3, eos_id=EOS,
+                                speculative=True, gamma=2, draft_cfg=cfg)
+    requests = _requests(cfg, 5, seed=0)
+    res0 = ServingEngine(base, params).run(requests, policy="continuous")
+    res1 = ServingEngine(spec, params, draft_params=params).run(
+        requests, policy="continuous")
+    assert _by_rid(res1) == _by_rid(res0)
+    assert res1.ticks < res0.ticks, (res1.ticks, res0.ticks)
+    assert res1.acceptance_rate is not None and res1.acceptance_rate > 0
+    alm = res1.accepted_len_mean
+    assert alm is not None and 1.0 <= alm <= 3.0
+
+
+def test_spec_parity_tp_pp_mesh():
+    """pipe x model: the verify head goes vocab-parallel per row and the
+    draft runs replicated inside stage 0's TP group — completions still
+    bit-match the plain TP engine."""
+    cfg = _cfg("llama", n_kv_heads=2)
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    mesh = make_mesh(n_pipe=2, n_model=2)
+    base = make_serving_step_fn(cfg, mesh, n_slots=2, max_len=20,
+                                prompt_max=6, out_max=6,
+                                prefill_chunk=2, eos_id=EOS)
+    spec = make_serving_step_fn(cfg, mesh, n_slots=2, max_len=20,
+                                prompt_max=6, out_max=6,
+                                prefill_chunk=2, eos_id=EOS,
+                                speculative=True, gamma=1, draft_cfg=cfg)
+    requests = _requests(cfg, 3, seed=9, prompt_max=6, out_max=6)
+    res0 = ServingEngine(base, params).run(requests, policy="continuous")
+    res1 = ServingEngine(spec, params, draft_params=params).run(
+        requests, policy="continuous")
+    assert _by_rid(res1) == _by_rid(res0)
+    assert spec.step._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# paged + speculative: committed-frontier rollback
+# ---------------------------------------------------------------------------
+
+
+def test_spec_paged_parity_and_invariants():
+    """Paged + speculative on a shared-prefix mix: completions bit-match
+    the plain contiguous engine, prefix pages are actually reused (COW
+    interplay), the committed frontier never outruns the accepted
+    position, and the drained pool passes check_invariants()."""
+    cfg = _cfg()
+    params = tfm.transformer_init(jax.random.key(0), cfg)
+    mesh = make_mesh(n_pipe=2)
+    base = make_serving_step_fn(cfg, mesh, n_slots=3, max_len=24,
+                                prompt_max=8, out_max=8,
+                                prefill_chunk=3, eos_id=EOS)
+    spec = make_serving_step_fn(cfg, mesh, n_slots=3, max_len=24,
+                                prompt_max=8, out_max=8,
+                                prefill_chunk=3, eos_id=EOS,
+                                paged=True, page_size=4,
+                                speculative=True, gamma=2, draft_cfg=cfg)
+    shared = [11, 22, 33, 44, 55, 66]
+    requests = [Request(rid=i, prompt=shared + [i % 7],
+                        max_new_tokens=4 + i % 3, arrival=float(i) * 2.0)
+                for i in range(6)]
+    res0 = ServingEngine(base, params).run(requests, policy="continuous")
+    eng1 = ServingEngine(spec, params, draft_params=params)
+    res1 = eng1.run(requests, policy="continuous")
+    assert _by_rid(res1) == _by_rid(res0)
+    assert res1.prefix_hit_rate and res1.prefix_hit_rate > 0
+    eng1.paging.check_invariants()  # raises on any leak / torn frontier
+
+
+def test_paged_committed_frontier_ledger():
+    """The allocator-side rollback contract in isolation: the committed
+    frontier only moves forward, never past the reservation, and retire
+    caps the radix insert at the committed length (speculative overshoot
+    must not become a reusable 'prefix')."""
+    from distributed_training_with_pipeline_parallelism_tpu.serving.paging import (  # noqa: E501
+        PagedKVAllocator)
+    alloc = PagedKVAllocator(n_pages=24, page_size=4,
+                             max_pages_per_slot=8, prefill_chunk=3)
+    prompt = [1, 2, 3, 4, 5]
+    plan = alloc.try_admit(prompt, budget=4)
+    assert plan is not None
+    alloc.bind(0, plan)
+    assert alloc.committed_rows(0) == plan.matched_len
+    alloc.advance(0, 6)
+    assert alloc.committed_rows(0) == 6
+    with pytest.raises(ValueError, match="backwards"):
+        alloc.advance(0, 5)
+    with pytest.raises(ValueError, match="reservation"):
+        alloc.advance(0, plan.n_pages * 4 + 1)
+    with pytest.raises(ValueError, match="unbound"):
+        alloc.advance(3, 1)
+    alloc.retire(0, prompt)
+    # a slot retired with its frontier short of its prompt must not seed
+    # the trie with uncommitted rows: re-admitting the same prompt sees
+    # no cached prefix
+    long = [9] * 9
+    plan2 = alloc.try_admit(long, budget=4)
+    alloc.bind(1, plan2)
+    alloc.advance(1, 3)  # accepted only 3 of the 9 prompt rows
+    alloc.retire(1, long)
+    plan3 = alloc.try_admit(long, budget=4)
+    assert plan3.matched_len == 0
+    alloc.bind(2, plan3)
+    alloc.advance(2, 9)
+    alloc.retire(2, long)
+    alloc.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# zero-finished hardening + summary gauges
+# ---------------------------------------------------------------------------
+
+
+def test_serving_summary_zero_finished():
+    """A sweep point that admits and finishes nothing must summarize to
+    None/0 fields, not a ZeroDivisionError (slo.py attainment ditto)."""
+    from distributed_training_with_pipeline_parallelism_tpu.serving.engine import (  # noqa: E501
+        ServeResult)
+    from distributed_training_with_pipeline_parallelism_tpu.serving.slo import (  # noqa: E501
+        SLOSpec, slo_attainment)
+    from distributed_training_with_pipeline_parallelism_tpu.utils.telemetry import (  # noqa: E501
+        serving_summary)
+    empty = ServeResult(completions=[], occupancy=[], ticks=0, wall_s=0.0,
+                        n_slots=3, policy="continuous", speculative=True,
+                        gamma=2)
+    s = serving_summary(empty)
+    assert s["s_per_tick"] is None
+    assert s["tokens_per_sec"] == 0.0
+    assert s["ttft_ticks"]["p99"] is None
+    assert s["speculative"] is True
+    assert s["acceptance_rate"] is None
+    assert s["accepted_len_mean"] is None
+    att = slo_attainment(empty, SLOSpec(ttft_p99_ticks=10.0))
+    assert att["attainment"] is None
+    assert att["goodput_under_slo"] is None
+
+
+def test_spec_summary_fields_ride_summary():
+    """A speculative run's serving_summary carries the acceptance
+    gauges; a plain run's summary stays byte-identical (no spec keys)."""
+    from distributed_training_with_pipeline_parallelism_tpu.serving.engine import (  # noqa: E501
+        ServeResult)
+    from distributed_training_with_pipeline_parallelism_tpu.utils.telemetry import (  # noqa: E501
+        serving_summary)
+    spec = ServeResult(completions=[], occupancy=[], ticks=4, wall_s=0.1,
+                       n_slots=2, policy="continuous", speculative=True,
+                       gamma=2, spec_verify_visits=10,
+                       spec_accepted_tokens=15,
+                       acceptance_series=[(3, 0.75), (4, None)])
+    s = serving_summary(spec)
+    assert s["gamma"] == 2
+    assert s["acceptance_rate"] == pytest.approx(0.75)
+    assert s["accepted_len_mean"] == pytest.approx(2.5)
+    assert s["acceptance_series"] == [[3, 0.75], [4, None]]
+    plain = ServeResult(completions=[], occupancy=[], ticks=4, wall_s=0.1,
+                        n_slots=2, policy="continuous")
+    assert "speculative" not in serving_summary(plain)
+    assert "acceptance_rate" not in serving_summary(plain)
+
+
+def test_spec_cost_model_section():
+    from distributed_training_with_pipeline_parallelism_tpu.analysis import (
+        serving_cost_model_section)
+    cfg = _cfg()
+    summary = {"ticks": 100, "wall_s": 1.0, "tokens_out": 200,
+               "speculative": True, "gamma": 2, "acceptance_rate": 0.5}
+    sec = serving_cost_model_section(cfg, 2, 3, summary, draft_cfg=cfg)
+    spec = sec["speculative"]
+    assert spec["expected_tokens_per_tick"] == pytest.approx(1.75)
+    assert spec["draft_flops_per_token"] > 0
+    assert spec["flops_per_tick"]["verify"] == pytest.approx(
+        3 * sec["flops"]["fwd_per_token"])
+    assert spec["predicted"]["tick_s"] > sec["predicted"]["step_s"]
+    # a zero-visit point: alpha None degrades to the no-accept floor
+    summary2 = dict(summary, acceptance_rate=None)
+    sec2 = serving_cost_model_section(cfg, 2, 3, summary2, draft_cfg=cfg)
+    assert sec2["speculative"]["expected_tokens_per_tick"] == 1.0
